@@ -1,0 +1,16 @@
+"""deeplearning4j_tpu: a TPU-native deep-learning framework with the
+capabilities of Deeplearning4j, built on JAX/XLA/Pallas/pjit.
+
+Reference capability map: /root/repo/SURVEY.md (structural analysis of
+dachylong/deeplearning4j @ 0.8.1-SNAPSHOT).
+"""
+__version__ = "0.1.0"
+
+from .nn.conf.config import NeuralNetConfiguration, MultiLayerConfiguration
+from .nn.inputs import InputType
+from .nn.multilayer import MultiLayerNetwork
+
+__all__ = [
+    "NeuralNetConfiguration", "MultiLayerConfiguration", "InputType",
+    "MultiLayerNetwork",
+]

@@ -1,0 +1,119 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Reference: eval/Evaluation.java:72 (eval(realOutcomes, guesses) :288),
+stats() text report, per-class precision/recall/f1, top-N accuracy.
+Computed host-side in numpy — evaluation is not a hot path; the device only
+produces the network output.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[List[str]] = None,
+                 top_n: int = 1):
+        self.n_classes = n_classes
+        self.label_names = labels
+        self.top_n = max(1, top_n)
+        self.confusion: Optional[np.ndarray] = None
+        self.top_n_correct = 0
+        self.count = 0
+
+    def _ensure(self, n):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or n
+            self.confusion = np.zeros((self.n_classes, self.n_classes), dtype=np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels: one-hot [N,C] (or int [N]); predictions: scores [N,C].
+        For time series, [N,T,C] with optional mask [N,T]."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            c = labels.shape[-1]
+            m = None if mask is None else np.asarray(mask).reshape(-1).astype(bool)
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+            if m is not None:
+                labels, predictions = labels[m], predictions[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        if labels.ndim == 2:
+            true_idx = np.argmax(labels, axis=-1)
+        else:
+            true_idx = labels.astype(np.int64)
+        self._ensure(predictions.shape[-1])
+        pred_idx = np.argmax(predictions, axis=-1)
+        np.add.at(self.confusion, (true_idx, pred_idx), 1)
+        if self.top_n > 1:
+            topn = np.argsort(-predictions, axis=-1)[:, :self.top_n]
+            self.top_n_correct += int(np.sum(topn == true_idx[:, None]))
+        self.count += len(true_idx)
+
+    # ----------------------------------------------------------------- stats
+    def accuracy(self) -> float:
+        c = self.confusion
+        return float(np.trace(c) / max(c.sum(), 1))
+
+    def top_n_accuracy(self) -> float:
+        if self.top_n == 1:
+            return self.accuracy()
+        return self.top_n_correct / max(self.count, 1)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        c = self.confusion
+        if cls is not None:
+            denom = c[:, cls].sum()
+            return float(c[cls, cls] / denom) if denom else 0.0
+        vals = [self.precision(i) for i in range(c.shape[0]) if c[:, i].sum() or c[i].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        c = self.confusion
+        if cls is not None:
+            denom = c[cls, :].sum()
+            return float(c[cls, cls] / denom) if denom else 0.0
+        vals = [self.recall(i) for i in range(c.shape[0]) if c[:, i].sum() or c[i].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        c = self.confusion
+        fp = c[:, cls].sum() - c[cls, cls]
+        tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
+        return float(fp / max(fp + tn, 1))
+
+    def false_negative_rate(self, cls: int) -> float:
+        c = self.confusion
+        fn = c[cls, :].sum() - c[cls, cls]
+        return float(fn / max(c[cls, :].sum(), 1))
+
+    def stats(self) -> str:
+        lines = ["========================Evaluation Metrics========================",
+                 f" # of classes:  {self.confusion.shape[0]}",
+                 f" Examples:      {self.confusion.sum()}",
+                 f" Accuracy:      {self.accuracy():.4f}",
+                 f" Precision:     {self.precision():.4f}",
+                 f" Recall:        {self.recall():.4f}",
+                 f" F1 Score:      {self.f1():.4f}"]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("\nConfusion matrix (rows=actual, cols=predicted):")
+        lines.append(str(self.confusion))
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation"):
+        if other.confusion is None:
+            return self
+        self._ensure(other.confusion.shape[0])
+        self.confusion += other.confusion
+        self.top_n_correct += other.top_n_correct
+        self.count += other.count
+        return self

@@ -1,0 +1,85 @@
+"""Activation function registry.
+
+Covers the reference's Activation enum surface (reference:
+nd4j Activation / used via string in deeplearning4j-nn layer configs, e.g.
+nn/conf/layers/* ``activation(...)``): identity, relu, leakyrelu, sigmoid,
+softmax, tanh, softplus, softsign, elu, selu, cube, hardtanh, hardsigmoid,
+rationaltanh, rrelu(-as-leakyrelu), plus TPU-era additions (gelu, swish).
+
+All are pure jnp functions — they fuse into the surrounding XLA computation
+(the reference dispatches each through an ND4J transform op; on TPU they are
+free, folded into the preceding matmul's epilogue by XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {}
+
+
+def register_activation(name):
+    def deco(fn):
+        _ACTIVATIONS[name] = fn
+        return fn
+    return deco
+
+
+def get_activation(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation {name!r}; available: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+def activation_names():
+    return sorted(_ACTIVATIONS)
+
+
+register_activation("identity")(lambda x: x)
+register_activation("relu")(jax.nn.relu)
+register_activation("relu6")(jax.nn.relu6)
+register_activation("sigmoid")(jax.nn.sigmoid)
+register_activation("tanh")(jnp.tanh)
+register_activation("softplus")(jax.nn.softplus)
+register_activation("softsign")(jax.nn.soft_sign)
+register_activation("elu")(jax.nn.elu)
+register_activation("selu")(jax.nn.selu)
+register_activation("gelu")(jax.nn.gelu)
+register_activation("swish")(jax.nn.silu)
+register_activation("cube")(lambda x: x ** 3)
+register_activation("hardtanh")(lambda x: jnp.clip(x, -1.0, 1.0))
+register_activation("hardsigmoid")(jax.nn.hard_sigmoid)
+
+
+@register_activation("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register_activation("logsoftmax")
+def log_softmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register_activation("leakyrelu")
+def leaky_relu(x):
+    # Reference default alpha = 0.01
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@register_activation("rrelu")
+def rrelu(x):
+    # Deterministic rrelu (mean slope) — reference randomizes slope in train.
+    return jax.nn.leaky_relu(x, negative_slope=(1.0 / 8.0 + 1.0 / 3.0) / 2.0)
+
+
+@register_activation("rationaltanh")
+def rational_tanh(x):
+    """Rational approximation of 1.7159*tanh(2x/3) (reference ActivationRationalTanh)."""
+    y = 2.0 * x / 3.0
+    a = jnp.abs(y)
+    approx = 1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y ** 4))
+    return 1.7159 * jnp.sign(y) * approx

@@ -1,0 +1,193 @@
+"""Network configuration: fluent builder -> serializable MultiLayerConfiguration.
+
+Reference: nn/conf/NeuralNetConfiguration.java:76 (Builder :535 — global
+hyperparams cascaded into per-layer confs at build, :604-608),
+nn/conf/MultiLayerConfiguration.java (JSON round-trip), BackpropType enum.
+
+The TPU build keeps: the cascade semantics, n_in inference from InputType,
+automatic preprocessor insertion, and config-as-JSON persistence. It drops:
+workspace/cache modes (subsumed by XLA buffer assignment) — accepted as no-op
+kwargs for API familiarity.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serde
+from .serde import register
+from ..inputs import InputType, InputTypeFeedForward
+from ..preprocessors import auto_preprocessor
+from ...optimize.updaters import Sgd, UpdaterConf, updater_from_name
+
+
+@register
+@dataclass
+class MultiLayerConfiguration:
+    layers: List[Any] = field(default_factory=list)
+    input_preprocessors: Dict[str, Any] = field(default_factory=dict)  # idx(str) -> preproc
+    input_type: Optional[Any] = None
+    seed: int = 12345
+    dtype: str = "float32"
+    backprop_type: str = "standard"       # "standard" | "tbptt"
+    tbptt_fwd_length: int = 20
+    tbptt_bwd_length: int = 20
+    pretrain: bool = False
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    updater: Optional[Any] = None         # global updater (layers may override)
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return serde.from_json(s)
+
+    def preprocessor(self, idx: int):
+        return self.input_preprocessors.get(str(idx))
+
+
+class NeuralNetConfiguration:
+    """Global-defaults builder (reference NeuralNetConfiguration.Builder).
+
+    Usage::
+
+        conf = (NeuralNetConfiguration(seed=42, updater=Adam(1e-3), l2=1e-4,
+                                       weight_init="xavier", activation="relu")
+                .list(DenseLayer(n_out=128),
+                      OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional_flat(28, 28, 1))
+                .build())
+    """
+
+    def __init__(self, seed: int = 12345, activation: str = "sigmoid",
+                 weight_init: str = "xavier", bias_init: float = 0.0,
+                 distribution=None, l1: float = 0.0, l2: float = 0.0,
+                 dropout: float = 0.0, updater=None, learning_rate: Optional[float] = None,
+                 bias_learning_rate: Optional[float] = None,
+                 gradient_normalization: Optional[str] = None,
+                 gradient_normalization_threshold: float = 1.0,
+                 dtype: str = "float32", **workspace_noops):
+        if updater is None:
+            updater = Sgd(learning_rate=learning_rate if learning_rate is not None else 0.1)
+        elif isinstance(updater, str):
+            updater = updater_from_name(updater, learning_rate or 0.1)
+        elif learning_rate is not None and updater.learning_rate != learning_rate:
+            updater = dataclasses.replace(updater, learning_rate=learning_rate)
+        self.seed = seed
+        self.activation = activation
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+        self.distribution = distribution
+        self.l1 = l1
+        self.l2 = l2
+        self.dropout = dropout
+        self.updater = updater
+        self.learning_rate = learning_rate
+        self.bias_learning_rate = bias_learning_rate
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = gradient_normalization_threshold
+        self.dtype = dtype
+
+    # --- cascade (reference :604-608): fill None fields from globals ---
+    def _cascade(self, layer):
+        layer = dataclasses.replace(layer)
+        if layer.activation is None:
+            layer.activation = self.activation
+        if layer.weight_init is None:
+            layer.weight_init = self.weight_init
+        if layer.distribution is None:
+            layer.distribution = self.distribution
+        if layer.bias_init is None:
+            layer.bias_init = self.bias_init
+        if layer.l1 is None:
+            layer.l1 = self.l1
+        if layer.l2 is None:
+            layer.l2 = self.l2
+        if layer.dropout is None:
+            layer.dropout = self.dropout
+        if layer.bias_learning_rate is None:
+            layer.bias_learning_rate = self.bias_learning_rate
+        return layer
+
+    def list(self, *layers) -> "ListBuilder":
+        return ListBuilder(self, list(layers))
+
+    def graph_builder(self) -> "Any":
+        try:
+            from .graph_conf import GraphBuilder
+        except ImportError as e:
+            raise NotImplementedError(
+                "ComputationGraph configuration lands with the DAG executor") from e
+        return GraphBuilder(self)
+
+
+class ListBuilder:
+    def __init__(self, nn_conf: NeuralNetConfiguration, layers: List[Any]):
+        self.nn_conf = nn_conf
+        self.layers = layers
+        self._input_type = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_bwd = 20
+        self._pretrain = False
+
+    def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
+        self.layers.append(maybe_layer if maybe_layer is not None else layer_or_idx)
+        return self
+
+    def set_input_type(self, itype) -> "ListBuilder":
+        self._input_type = itype
+        return self
+
+    def backprop_type(self, bp: str) -> "ListBuilder":
+        self._backprop_type = bp
+        return self
+
+    def tbptt_length(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
+        self._backprop_type = "tbptt"
+        self._tbptt_fwd = fwd
+        self._tbptt_bwd = bwd if bwd is not None else fwd
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = flag
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        nc = self.nn_conf
+        itype = self._input_type
+        if itype is None:
+            first = self.layers[0]
+            n_in = getattr(first, "n_in", None)
+            if n_in:
+                itype = InputTypeFeedForward(n_in)
+        resolved, preprocs = [], {}
+        for i, layer in enumerate(self.layers):
+            layer = nc._cascade(layer)
+            if itype is not None:
+                pre, itype = auto_preprocessor(itype, layer.expected_input)
+                if pre is not None:
+                    preprocs[str(i)] = pre
+                if getattr(layer, "n_in", "absent") is None:
+                    layer.n_in = _infer_n_in(layer, itype)
+                itype = layer.output_type(itype)
+            resolved.append(layer)
+        return MultiLayerConfiguration(
+            layers=resolved, input_preprocessors=preprocs,
+            input_type=self._input_type, seed=nc.seed, dtype=nc.dtype,
+            backprop_type=self._backprop_type, tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_bwd_length=self._tbptt_bwd, pretrain=self._pretrain,
+            gradient_normalization=nc.gradient_normalization,
+            gradient_normalization_threshold=nc.gradient_normalization_threshold,
+            updater=nc.updater)
+
+
+def _infer_n_in(layer, itype):
+    from ..layers.base import resolve_ff_size
+    from ..inputs import InputTypeConvolutional
+    if layer.expected_input == "cnn" and isinstance(itype, InputTypeConvolutional):
+        return itype.channels
+    return resolve_ff_size(itype)

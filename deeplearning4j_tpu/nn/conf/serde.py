@@ -1,0 +1,92 @@
+"""Config serialization framework.
+
+The reference serializes every network configuration to JSON/YAML and treats the
+JSON as the persistence format inside model zips (reference:
+deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/MultiLayerConfiguration.java
+toJson/fromJson; custom deserializers in nn/conf/serde/BaseNetConfigDeserializer.java).
+
+Here every serializable config object is a dataclass registered in a global
+registry; encoding tags each object with ``"@class"`` so round-trips reconstruct
+the exact type. Version shims can be added per-class via ``_migrate``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Dict, Type
+
+_REGISTRY: Dict[str, Type] = {}
+
+CONFIG_FORMAT_VERSION = 1
+
+
+def register(cls):
+    """Class decorator: make a dataclass JSON round-trippable."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def lookup(name: str):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"Unknown config class {name!r}; registered: {sorted(_REGISTRY)}")
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively encode a config object tree to plain JSON-able data."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return {"@enum": type(obj).__name__, "value": obj.name}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_dict(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        d = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            if not f.metadata.get("skip_serde", False):
+                d[f.name] = to_dict(getattr(obj, f.name))
+        return d
+    raise TypeError(f"Cannot serialize {type(obj)!r}: {obj!r}")
+
+
+def from_dict(data: Any) -> Any:
+    """Inverse of :func:`to_dict`."""
+    if data is None or isinstance(data, (bool, int, float, str)):
+        return data
+    if isinstance(data, list):
+        return [from_dict(v) for v in data]
+    if isinstance(data, dict):
+        if "@enum" in data:
+            return lookup(data["@enum"])[data["value"]]
+        if "@class" in data:
+            cls = lookup(data["@class"])
+            raw = {k: from_dict(v) for k, v in data.items() if k != "@class"}
+            if hasattr(cls, "_migrate"):
+                raw = cls._migrate(raw)
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: v for k, v in raw.items() if k in field_names}
+            return cls(**kwargs)
+        return {k: from_dict(v) for k, v in data.items()}
+    raise TypeError(f"Cannot deserialize {data!r}")
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps({"format_version": CONFIG_FORMAT_VERSION, "config": to_dict(obj)},
+                      indent=indent)
+
+
+def from_json(s: str) -> Any:
+    data = json.loads(s)
+    if isinstance(data, dict) and "format_version" in data:
+        data = data["config"]
+    return from_dict(data)
+
+
+def register_enum(cls):
+    """Decorator registering an Enum for serde."""
+    _REGISTRY[cls.__name__] = cls
+    return cls

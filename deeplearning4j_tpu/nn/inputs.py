@@ -1,0 +1,95 @@
+"""Input type system: shape inference between layers.
+
+Mirrors the capability of the reference InputType system
+(reference: deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/inputs/InputType.java:62-94),
+which drives automatic nIn inference and automatic insertion of input
+preprocessors between layer families (CNN<->FF, FF<->RNN, CNN<->RNN).
+
+TPU note: all shapes here are static python ints — XLA requires static shapes,
+so shape inference happens once at config-build time, never inside jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from .conf.serde import register
+
+
+class InputType:
+    """Factory namespace, mirroring InputType.feedForward(...) etc."""
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputTypeFeedForward":
+        return InputTypeFeedForward(int(size))
+
+    @staticmethod
+    def recurrent(size: int, timestep_length: int = -1) -> "InputTypeRecurrent":
+        return InputTypeRecurrent(int(size), int(timestep_length))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputTypeConvolutional":
+        return InputTypeConvolutional(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputTypeConvolutionalFlat":
+        return InputTypeConvolutionalFlat(int(height), int(width), int(channels))
+
+
+@register
+@dataclass(frozen=True)
+class InputTypeFeedForward:
+    size: int
+
+    def flat_size(self) -> int:
+        return self.size
+
+    def batch_shape(self, batch: int):
+        return (batch, self.size)
+
+
+@register
+@dataclass(frozen=True)
+class InputTypeRecurrent:
+    size: int
+    timestep_length: int = -1
+
+    def flat_size(self) -> int:
+        return self.size
+
+    def batch_shape(self, batch: int):
+        # Layout: [batch, time, features] (time-major inside scan is handled by
+        # the layer; public layout is batch-major, unlike the reference's
+        # [miniBatch, size, timeSeriesLength] NCW layout — BTC is the
+        # TPU/XLA-friendly layout for scan + masking).
+        return (batch, self.timestep_length, self.size)
+
+
+@register
+@dataclass(frozen=True)
+class InputTypeConvolutional:
+    height: int
+    width: int
+    channels: int
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+    def batch_shape(self, batch: int):
+        # NHWC: TPU-native conv layout (the reference uses NCHW for cuDNN;
+        # XLA:TPU prefers NHWC with channels on the 128-lane minor dim).
+        return (batch, self.height, self.width, self.channels)
+
+
+@register
+@dataclass(frozen=True)
+class InputTypeConvolutionalFlat:
+    height: int
+    width: int
+    channels: int
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+    def batch_shape(self, batch: int):
+        return (batch, self.flat_size())

@@ -1,0 +1,112 @@
+"""Layer SPI: config dataclasses with pure init/apply functions.
+
+The reference splits each layer into a config class (nn/conf/layers/*) and an
+impl class (nn/layers/*) holding INDArray views into the flat parameter buffer
+(reference: nn/api/Layer.java:40 Layer SPI; nn/params/* param initializers).
+Here a layer is ONE dataclass: serializable hyperparameters plus pure
+``init``/``apply`` functions over param pytrees — the TPU-idiomatic form
+(params live in a pytree; XLA fuses the whole network into one program, so
+there is no per-layer execution object).
+
+Mutable per-layer state (batch-norm running stats, reference
+nn/layers/normalization/BatchNormalization.java) is threaded functionally:
+``apply`` returns ``(output, new_state)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+from ..activations import get_activation
+from ..weights import init_weights
+from ..inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+                      InputTypeFeedForward, InputTypeRecurrent)
+
+
+def maybe_dropout(x, retain_prob, rng, train):
+    """Inverted dropout on a layer's input (reference util/Dropout.java:
+    applyDropout — ``dropOut`` is the RETAIN probability; scaling by 1/p at
+    train time so inference is identity)."""
+    if not train or retain_prob is None or retain_prob <= 0 or retain_prob >= 1:
+        return x
+    keep = jax.random.bernoulli(rng, retain_prob, x.shape)
+    return jnp.where(keep, x / retain_prob, 0.0).astype(x.dtype)
+
+
+@dataclass
+class LayerConf:
+    """Base for all layer configs. Fields that are None inherit the global
+    default from NeuralNetConfiguration at build() time (reference:
+    NeuralNetConfiguration.Builder cascade, NeuralNetConfiguration.java:604-608).
+    """
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    distribution: Optional[Any] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None           # retain probability; 0/None = off
+    updater: Optional[Any] = None             # per-layer IUpdater override
+    learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
+
+    # --- class-level metadata overridden by subclasses (not serialized) ---
+    param_order: ClassVar[Tuple[str, ...]] = ()
+    weight_param_names: ClassVar[Tuple[str, ...]] = ("W",)
+    expected_input: ClassVar[str] = "ff"
+
+    # ---- SPI ----
+    def output_type(self, itype):
+        return itype
+
+    def init(self, rng, itype, dtype) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        return {}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        raise NotImplementedError
+
+    # ---- helpers ----
+    def act(self, x):
+        return get_activation(self.activation or "identity")(x)
+
+    def has_params(self):
+        return bool(self.param_order)
+
+    def _winit(self, rng, shape, fan_in, fan_out, dtype):
+        return init_weights(rng, shape, self.weight_init or "xavier", fan_in,
+                            fan_out, dtype, self.distribution)
+
+    def _binit(self, shape, dtype):
+        return jnp.full(shape, self.bias_init or 0.0, dtype)
+
+    def regularization(self, params):
+        """0.5*l2*||W||^2 + l1*|W| over weight params only (reference
+        BaseLayer.calcL2/calcL1)."""
+        reg = 0.0
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        if l1 == 0.0 and l2 == 0.0:
+            return 0.0
+        for name in self.weight_param_names:
+            if name in params:
+                w = params[name]
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(w * w)
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return reg
+
+
+def resolve_ff_size(itype) -> int:
+    """Feed-forward input width for a layer fed by ``itype``."""
+    if isinstance(itype, (InputTypeFeedForward, InputTypeRecurrent)):
+        return itype.size
+    if isinstance(itype, (InputTypeConvolutional, InputTypeConvolutionalFlat)):
+        return itype.flat_size()
+    raise ValueError(f"Cannot infer feed-forward size from {itype}")

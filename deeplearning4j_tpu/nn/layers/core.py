@@ -1,0 +1,216 @@
+"""Core feed-forward layers: Dense, Activation, Dropout, Embedding, Output family.
+
+Reference parity:
+- DenseLayer        -> nn/conf/layers/DenseLayer.java + nn/layers/feedforward/dense/DenseLayer.java
+- ActivationLayer   -> nn/conf/layers/ActivationLayer.java
+- DropoutLayer      -> nn/conf/layers/DropoutLayer.java
+- EmbeddingLayer    -> nn/layers/feedforward/embedding/EmbeddingLayer.java
+- OutputLayer       -> nn/conf/layers/OutputLayer.java + nn/layers/BaseOutputLayer
+- LossLayer         -> nn/conf/layers/LossLayer.java (no params, loss on input)
+- RnnOutputLayer    -> nn/conf/layers/RnnOutputLayer.java (time-distributed output)
+- AutoEncoder       -> nn/layers/feedforward/autoencoder/AutoEncoder.java (denoising AE)
+
+TPU notes: Dense on a recurrent [B,T,F] input applies per-timestep via a single
+batched matmul (equivalent to the reference's RnnToFeedForwardPreProcessor
+sandwich, but as ONE einsum the MXU tiles directly).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, ClassVar, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+from ..inputs import (InputTypeConvolutional, InputTypeConvolutionalFlat,
+                      InputTypeFeedForward, InputTypeRecurrent)
+from ..losses import get_loss
+from .base import LayerConf, maybe_dropout, resolve_ff_size
+
+
+@register
+@dataclass
+class DenseLayer(LayerConf):
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b")
+
+    def output_type(self, itype):
+        if isinstance(itype, InputTypeRecurrent):
+            return InputTypeRecurrent(self.n_out, itype.timestep_length)
+        return InputTypeFeedForward(self.n_out)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        W = self._winit(rng, (n_in, self.n_out), n_in, self.n_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype)}, {}
+
+    def pre_output(self, params, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        return x @ params["W"] + params["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.act(self.pre_output(params, x, train=train, rng=rng)), state
+
+
+@register
+@dataclass
+class ActivationLayer(LayerConf):
+    expected_input: ClassVar[str] = "any"
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.act(x), state
+
+
+@register
+@dataclass
+class DropoutLayer(LayerConf):
+    expected_input: ClassVar[str] = "any"
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return maybe_dropout(x, self.dropout, rng, train), state
+
+
+@register
+@dataclass
+class EmbeddingLayer(LayerConf):
+    """Index -> vector lookup. Input: int indices [B] or [B,1] (the reference
+    expects a single index column, EmbeddingLayer.java). A gather on TPU; the
+    backward pass is a scatter-add XLA emits natively."""
+    n_in: Optional[int] = None     # vocab size
+    n_out: int = 0
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b")
+    expected_input: ClassVar[str] = "any"
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(self.n_out)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        W = self._winit(rng, (n_in, self.n_out), n_in, self.n_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        out = params["W"][idx] + params["b"]
+        return self.act(out), state
+
+
+class BaseOutputLayerMixin:
+    """Shared loss plumbing for output layers (reference nn/layers/BaseOutputLayer).
+
+    ``compute_loss_per_example`` runs on PRE-activation output so softmax/sigmoid
+    cross-entropies take the fused stable path.
+    """
+
+    def compute_loss_per_example(self, params, x, labels, mask=None, *, train=False, rng=None):
+        pre = self.pre_output(params, x, train=train, rng=rng)
+        return get_loss(self.loss)(labels, pre, self.activation or "identity", mask)
+
+
+@register
+@dataclass
+class OutputLayer(DenseLayer, BaseOutputLayerMixin):
+    loss: str = "mcxent"
+
+
+@register
+@dataclass
+class LossLayer(LayerConf, BaseOutputLayerMixin):
+    """Loss on the incoming activations; no parameters."""
+    loss: str = "mcxent"
+    expected_input: ClassVar[str] = "any"
+
+    def pre_output(self, params, x, *, train=False, rng=None):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.act(x), state
+
+
+@register
+@dataclass
+class RnnOutputLayer(DenseLayer, BaseOutputLayerMixin):
+    """Time-distributed output layer for [B,T,F] activations (reference
+    nn/conf/layers/RnnOutputLayer.java; per-timestep loss with masking)."""
+    loss: str = "mcxent"
+    expected_input: ClassVar[str] = "rnn"
+
+    def output_type(self, itype):
+        t = itype.timestep_length if isinstance(itype, InputTypeRecurrent) else -1
+        return InputTypeRecurrent(self.n_out, t)
+
+
+@register
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Output layer with center loss (reference
+    nn/layers/training/CenterLossOutputLayer.java): adds lambda * ||f - c_y||^2
+    and maintains per-class centers with EMA alpha."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b", "centers")
+
+    def init(self, rng, itype, dtype):
+        params, state = super().init(rng, itype, dtype)
+        n_in = self.n_in or resolve_ff_size(itype)
+        params["centers"] = jnp.zeros((self.n_out, n_in), dtype)
+        return params, state
+
+    def compute_loss_per_example(self, params, x, labels, mask=None, *, train=False, rng=None):
+        base = super().compute_loss_per_example(params, x, labels, mask, train=train, rng=rng)
+        # Two one-sided terms replicate the reference's dynamics functionally:
+        # features are pulled toward (stop-gradient) centers at rate lambda;
+        # centers move toward (stop-gradient) features at rate alpha — SGD on
+        # the alpha term is the EMA center update of the reference.
+        centers_batch = labels @ params["centers"]  # [B, n_in], labels one-hot
+        pull = jnp.sum((x - jax.lax.stop_gradient(centers_batch)) ** 2, axis=-1)
+        chase = jnp.sum((jax.lax.stop_gradient(x) - centers_batch) ** 2, axis=-1)
+        return base + 0.5 * self.lambda_ * pull + 0.5 * self.alpha * chase
+
+
+@register
+@dataclass
+class AutoEncoder(LayerConf):
+    """Denoising autoencoder. As a feed-forward layer it is encode();
+    ``pretrain_loss`` gives the reconstruction objective with input corruption
+    (reference nn/layers/feedforward/autoencoder/AutoEncoder.java)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    corruption_level: float = 0.3
+    loss: str = "mse"
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b", "vb")
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(self.n_out)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        W = self._winit(rng, (n_in, self.n_out), n_in, self.n_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype),
+                "vb": self._binit((n_in,), dtype)}, {}
+
+    def encode(self, params, x):
+        return self.act(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.act(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        corrupt_rng, _ = jax.random.split(rng)
+        keep = jax.random.bernoulli(corrupt_rng, 1.0 - self.corruption_level, x.shape)
+        corrupted = jnp.where(keep, x, 0.0)
+        recon_pre = self.encode(params, corrupted) @ params["W"].T + params["vb"]
+        per_ex = get_loss(self.loss)(x, recon_pre, self.activation or "identity", None)
+        return jnp.mean(per_ex)

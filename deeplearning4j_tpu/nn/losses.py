@@ -1,0 +1,168 @@
+"""Loss functions.
+
+Parity with the reference LossFunctions surface (used by output layers via
+``lossFunction(...)``; the impls live in ND4J's nd4j-backends loss classes —
+referenced from nn/conf/layers/OutputLayer.java and
+nn/layers/BaseOutputLayer computeScore): MSE, L1, L2, XENT (binary CE),
+MCXENT, NEGATIVELOGLIKELIHOOD, HINGE, SQUARED_HINGE, KL_DIVERGENCE,
+MEAN_ABSOLUTE_ERROR, MEAN_ABSOLUTE_PERCENTAGE_ERROR,
+MEAN_SQUARED_LOGARITHMIC_ERROR, COSINE_PROXIMITY, POISSON.
+
+Each loss takes *pre-activation* output ("preout") plus the activation name so
+that softmax/sigmoid cross-entropies use the numerically-stable fused
+log-softmax / logits formulations (the reference relies on clipped doubles;
+fused logits is the XLA-friendly equivalent). Autodiff supplies gradients —
+the reference's hand-written computeGradient methods are unnecessary.
+
+All losses return per-example scores of shape [batch]; masks (per-element or
+per-example) multiply elementwise losses before reduction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .activations import get_activation
+
+_LOSSES = {}
+
+
+def register_loss(*names):
+    def deco(fn):
+        for n in names:
+            _LOSSES[n] = fn
+        return fn
+    return deco
+
+
+def get_loss(name):
+    key = str(name).lower()
+    if key not in _LOSSES:
+        raise ValueError(f"Unknown loss {name!r}; available: {sorted(_LOSSES)}")
+    return _LOSSES[key]
+
+
+def loss_names():
+    return sorted(_LOSSES)
+
+
+def _reduce(elementwise, mask):
+    """Sum elementwise loss over feature dims -> per-example score; apply mask."""
+    if mask is not None:
+        mask = jnp.broadcast_to(mask.astype(elementwise.dtype).reshape(
+            mask.shape + (1,) * (elementwise.ndim - mask.ndim)), elementwise.shape)
+        elementwise = elementwise * mask
+    axes = tuple(range(1, elementwise.ndim))
+    return jnp.sum(elementwise, axis=axes)
+
+
+def _activate(preout, activation):
+    return get_activation(activation)(preout)
+
+
+@register_loss("mse", "squared_loss")
+def mse(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    # Reference MSE divides by nOut (LossMSE = LossL2 / nOut).
+    return _reduce((out - labels) ** 2, mask) / labels.shape[-1]
+
+
+@register_loss("l2")
+def l2(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    return _reduce((out - labels) ** 2, mask)
+
+
+@register_loss("mean_absolute_error", "mae")
+def mae(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    return _reduce(jnp.abs(out - labels), mask) / labels.shape[-1]
+
+
+@register_loss("l1")
+def l1(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    return _reduce(jnp.abs(out - labels), mask)
+
+
+@register_loss("mean_absolute_percentage_error", "mape")
+def mape(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    eps = 1e-8
+    return _reduce(100.0 * jnp.abs((out - labels) / (labels + eps)), mask) / labels.shape[-1]
+
+
+@register_loss("mean_squared_logarithmic_error", "msle")
+def msle(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    eps = 1e-8
+    d = jnp.log1p(out + eps) - jnp.log1p(labels + eps)
+    return _reduce(d ** 2, mask) / labels.shape[-1]
+
+
+@register_loss("xent", "binary_crossentropy")
+def xent(labels, preout, activation, mask=None):
+    act = str(activation).lower()
+    if act == "sigmoid":
+        # Fused stable form from logits.
+        ew = jnp.maximum(preout, 0) - preout * labels + jnp.log1p(jnp.exp(-jnp.abs(preout)))
+    else:
+        out = jnp.clip(_activate(preout, activation), 1e-7, 1.0 - 1e-7)
+        ew = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    return _reduce(ew, mask)
+
+
+@register_loss("mcxent", "negativeloglikelihood", "categorical_crossentropy")
+def mcxent(labels, preout, activation, mask=None):
+    act = str(activation).lower()
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(_activate(preout, activation), 1e-7, 1.0))
+    return _reduce(-labels * logp, mask)
+
+
+@register_loss("sparse_mcxent")
+def sparse_mcxent(labels, preout, activation, mask=None):
+    """labels are integer class indices of shape [batch] (or [batch, time])."""
+    logp = jax.nn.log_softmax(preout, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if mask is not None:
+        picked = picked * mask.astype(picked.dtype)
+    axes = tuple(range(1, picked.ndim))
+    return -jnp.sum(picked, axis=axes) if axes else -picked
+
+
+@register_loss("hinge")
+def hinge(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    # labels in {-1, +1}
+    return _reduce(jnp.maximum(0.0, 1.0 - labels * out), mask)
+
+
+@register_loss("squared_hinge")
+def squared_hinge(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    return _reduce(jnp.maximum(0.0, 1.0 - labels * out) ** 2, mask)
+
+
+@register_loss("kl_divergence", "kld", "reconstruction_crossentropy")
+def kl_divergence(labels, preout, activation, mask=None):
+    out = jnp.clip(_activate(preout, activation), 1e-7, 1.0 - 1e-7)
+    lab = jnp.clip(labels, 1e-7, 1.0)
+    return _reduce(lab * (jnp.log(lab) - jnp.log(out)), mask)
+
+
+@register_loss("poisson")
+def poisson(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    return _reduce(out - labels * jnp.log(jnp.clip(out, 1e-7, None)), mask)
+
+
+@register_loss("cosine_proximity")
+def cosine_proximity(labels, preout, activation, mask=None):
+    out = _activate(preout, activation)
+    ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+    on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    cos = jnp.sum(labels * out, axis=-1, keepdims=True) / jnp.clip(ln * on, 1e-8, None)
+    return _reduce(-cos, mask)

@@ -1,0 +1,258 @@
+"""MultiLayerNetwork: sequential-stack executor.
+
+Reference: nn/multilayer/MultiLayerNetwork.java:88 — init/flatten params
+(:455,467), feedForward (:776-888), fit (:1076), backprop (:1186),
+computeGradientAndScore (:2121), evaluate, rnnTimeStep.
+
+TPU-first design: the reference orchestrates layer-by-layer on the host; here
+the ENTIRE forward(+backward+update) is one traced function that XLA compiles
+and fuses (the python layer loop unrolls at trace time). Parameters are a
+tuple-of-dicts pytree; the reference's single flattened parameter buffer
+(flattenedParams, MultiLayerNetwork.java:1202-1206) survives as
+``params_flat()`` — the canonical view for checkpointing, averaging and
+gradient checks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf.config import MultiLayerConfiguration
+from .layers.core import BaseOutputLayerMixin
+from ..optimize.updaters import MultiLayerUpdater
+
+
+def _dtype_of(conf) -> Any:
+    return jnp.dtype(conf.dtype)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = tuple(conf.layers)
+        self.params: Optional[Tuple[Dict[str, jnp.ndarray], ...]] = None
+        self.state: Optional[Tuple[Dict[str, jnp.ndarray], ...]] = None
+        self.updater = MultiLayerUpdater(
+            self.layers, conf.updater, conf.gradient_normalization,
+            conf.gradient_normalization_threshold)
+        self.opt_state = None
+        self.iteration_count = 0
+        self.listeners: List[Any] = []
+        self._rnn_state: Optional[list] = None
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None):
+        rng = jax.random.PRNGKey(self.conf.seed if seed is None else seed)
+        dtype = _dtype_of(self.conf)
+        itype = self.conf.input_type
+        params, state = [], []
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessor(i)
+            if pre is not None and itype is not None:
+                itype = pre.output_type(itype)
+            rng, sub = jax.random.split(rng)
+            p, s = layer.init(sub, itype, dtype)
+            params.append(p)
+            state.append(s)
+            if itype is not None:
+                itype = layer.output_type(itype)
+        self.params = tuple(params)
+        self.state = tuple(state)
+        self.opt_state = self.updater.init(self.params)
+        return self
+
+    # ------------------------------------------------------------- functional
+    def apply_fn(self, params, state, x, *, train: bool = False, rng=None,
+                 to_layer: Optional[int] = None, features_mask=None):
+        """Pure forward pass. Returns (activations_list, new_state).
+
+        activations_list[i] is the OUTPUT of layer i (post-preprocessor input
+        is applied before each layer), mirroring feedForwardToLayer
+        (reference MultiLayerNetwork.java:776-888).
+        """
+        acts = []
+        new_state = []
+        n = len(self.layers) if to_layer is None else to_layer + 1
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if features_mask is not None:
+            # Zero padded features/timesteps at the input (reference
+            # setLayerMaskArrays, MultiLayerNetwork.java:1144-1147; full
+            # per-layer MaskState propagation arrives with the recurrent stack).
+            m = jnp.asarray(features_mask, x.dtype)
+            x = x * m.reshape(m.shape + (1,) * (x.ndim - m.ndim))
+        for i in range(len(self.layers)):
+            if i >= n:
+                new_state.append(state[i])
+                continue
+            pre = self.conf.preprocessor(i)
+            if pre is not None:
+                x = pre.apply(x)
+            rng, sub = jax.random.split(rng)
+            x, s = self.layers[i].apply(params[i], state[i], x, train=train, rng=sub)
+            new_state.append(s)
+            acts.append(x)
+        return acts, tuple(new_state)
+
+    def loss_fn(self, params, state, x, labels, *, train: bool = True, rng=None,
+                labels_mask=None, features_mask=None):
+        """Mean per-example loss + L1/L2 regularization (reference
+        computeGradientAndScore :2121 + BaseLayer.calcL2/calcL1)."""
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, BaseOutputLayerMixin):
+            raise ValueError("Last layer must be an output layer to compute loss")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        rng, fwd_rng = jax.random.split(rng)
+        # forward to second-to-last layer
+        if len(self.layers) > 1:
+            acts, new_state = self.apply_fn(params, state, x, train=train, rng=fwd_rng,
+                                            to_layer=len(self.layers) - 2,
+                                            features_mask=features_mask)
+            feed = acts[-1] if acts else x
+        else:
+            feed = x
+            if features_mask is not None:
+                m = jnp.asarray(features_mask, x.dtype)
+                feed = feed * m.reshape(m.shape + (1,) * (feed.ndim - m.ndim))
+            new_state = state
+        pre = self.conf.preprocessor(len(self.layers) - 1)
+        if pre is not None:
+            feed = pre.apply(feed)
+        rng, sub = jax.random.split(rng)
+        per_ex = out_layer.compute_loss_per_example(
+            params[-1], feed, labels, labels_mask, train=train, rng=sub)
+        if labels_mask is not None and per_ex.ndim == 1 and labels_mask.ndim >= 2:
+            # per-timestep masked mean: normalize by active timesteps
+            denom = jnp.maximum(jnp.sum(labels_mask), 1.0)
+            score = jnp.sum(per_ex) / denom
+        else:
+            score = jnp.mean(per_ex)
+        reg = 0.0
+        for layer, p in zip(self.layers, params):
+            reg = reg + layer.regularization(p)
+        return score + reg, new_state
+
+    # ------------------------------------------------------------- inference
+    def _jitted(self, key, fn):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+    def output(self, x, train: bool = False):
+        x = jnp.asarray(x, _dtype_of(self.conf)) if not _is_int_input(x) else jnp.asarray(x)
+        fn = self._jitted(("output", train), functools.partial(self._output_pure, train=train))
+        return fn(self.params, self.state, x)
+
+    def _output_pure(self, params, state, x, *, train=False):
+        acts, _ = self.apply_fn(params, state, x, train=train)
+        return acts[-1]
+
+    def feed_forward(self, x, train: bool = False):
+        x = jnp.asarray(x)
+        acts, _ = self.apply_fn(self.params, self.state, x, train=train)
+        return [x] + acts
+
+    def score(self, x=None, y=None, dataset=None) -> float:
+        if dataset is not None:
+            x, y = dataset.features, dataset.labels
+            lm, fm = dataset.labels_mask, dataset.features_mask
+        else:
+            lm = fm = None
+        fn = self._jitted(("score", lm is not None, fm is not None),
+                          lambda p, s, xx, yy, lmm=None, fmm=None: self.loss_fn(
+                              p, s, xx, yy, train=False, labels_mask=lmm,
+                              features_mask=fmm)[0])
+        args = [self.params, self.state, jnp.asarray(x), jnp.asarray(y)]
+        kwargs = {}
+        if lm is not None:
+            kwargs["lmm"] = jnp.asarray(lm)
+        if fm is not None:
+            kwargs["fmm"] = jnp.asarray(fm)
+        return float(fn(*args, **kwargs))
+
+    # ------------------------------------------------------------ flat params
+    def params_flat(self) -> jnp.ndarray:
+        """All parameters as ONE 1-D vector (reference flattenedParams)."""
+        leaves = []
+        for layer, p in zip(self.layers, self.params):
+            for name in layer.param_order:
+                if name in p:
+                    leaves.append(jnp.ravel(p[name]))
+        if not leaves:
+            return jnp.zeros((0,), _dtype_of(self.conf))
+        return jnp.concatenate(leaves)
+
+    def set_params_flat(self, flat):
+        flat = jnp.asarray(flat)
+        expected = self.num_params()
+        if flat.shape != (expected,):
+            raise ValueError(f"Expected flat parameter vector of length {expected}, "
+                             f"got shape {flat.shape}")
+        new_params, off = [], 0
+        for layer, p in zip(self.layers, self.params):
+            np_ = dict(p)
+            for name in layer.param_order:
+                if name in p:
+                    n = int(np.prod(p[name].shape)) if p[name].ndim else 1
+                    np_[name] = flat[off:off + n].reshape(p[name].shape).astype(p[name].dtype)
+                    off += n
+            new_params.append(np_)
+        self.params = tuple(new_params)
+
+    def num_params(self) -> int:
+        return int(sum(int(np.prod(v.shape)) for p in self.params for v in p.values()))
+
+    # ------------------------------------------------------------------ train
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def _solver(self):
+        # One persistent Solver so the jitted train step survives across fit()
+        # calls (the reference reuses its Solver too, MultiLayerNetwork.java:1155).
+        if not hasattr(self, "_solver_inst"):
+            from ..optimize.solver import Solver
+            self._solver_inst = Solver(self)
+        return self._solver_inst
+
+    def fit(self, data=None, labels=None, *, epochs: int = 1, batch_size: Optional[int] = None,
+            iterator=None, dataset=None):
+        self._solver().fit(data=data, labels=labels, epochs=epochs,
+                           batch_size=batch_size, iterator=iterator, dataset=dataset)
+        return self
+
+    def pretrain(self, iterator, epochs: int = 1):
+        self._solver().pretrain(iterator, epochs=epochs)
+        return self
+
+    # ------------------------------------------------------------------ eval
+    def evaluate(self, iterator_or_x, y=None):
+        from ..eval.evaluation import Evaluation
+        e = Evaluation()
+        if y is not None:
+            e.eval(y, np.asarray(self.output(iterator_or_x)))
+            return e
+        for ds in iterator_or_x:
+            out = np.asarray(self.output(ds.features))
+            e.eval(ds.labels, out, mask=ds.labels_mask)
+        return e
+
+    # ------------------------------------------------------------------ misc
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        other = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self.params is not None:
+            other.params = jax.tree.map(lambda a: a, self.params)
+            other.state = jax.tree.map(lambda a: a, self.state)
+            other.opt_state = jax.tree.map(lambda a: a, self.opt_state)
+        return other
+
+
+def _is_int_input(x):
+    return np.asarray(x).dtype.kind in "iu"

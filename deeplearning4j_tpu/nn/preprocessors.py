@@ -1,0 +1,135 @@
+"""Input preprocessors: shape adapters between layer families.
+
+Reference: nn/conf/preprocessor/* (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor) —
+auto-inserted by the InputType system (nn/conf/inputs/InputType.java:62-94).
+
+All are pure static reshapes/transposes: free under XLA (layout changes fuse).
+Layouts: FF [B,F]; RNN [B,T,F]; CNN [B,H,W,C] (NHWC, TPU-native — the
+reference is NCHW for cuDNN).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .conf.serde import register
+from .inputs import (InputType, InputTypeConvolutional, InputTypeConvolutionalFlat,
+                     InputTypeFeedForward, InputTypeRecurrent)
+
+
+@register
+@dataclass
+class CnnToFeedForwardPreProcessor:
+    height: int
+    width: int
+    channels: int
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(self.height * self.width * self.channels)
+
+
+@register
+@dataclass
+class FeedForwardToCnnPreProcessor:
+    height: int
+    width: int
+    channels: int
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, itype):
+        return InputTypeConvolutional(self.height, self.width, self.channels)
+
+
+@register
+@dataclass
+class RnnToFeedForwardPreProcessor:
+    """[B,T,F] -> [B*T,F]. Rarely needed on TPU (dense layers are
+    time-distributed natively) but provided for explicit reference parity."""
+
+    def apply(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(itype.size)
+
+
+@register
+@dataclass
+class FeedForwardToRnnPreProcessor:
+    timestep_length: int = -1
+
+    def apply(self, x):
+        t = self.timestep_length
+        return x.reshape(-1, t, x.shape[-1])
+
+    def output_type(self, itype):
+        return InputTypeRecurrent(itype.size, self.timestep_length)
+
+
+@register
+@dataclass
+class CnnToRnnPreProcessor:
+    """[B,T? folded] — reference folds CNN activations per timestep. Layout
+    here: [B*T,H,W,C] -> [B,T,H*W*C]."""
+    height: int
+    width: int
+    channels: int
+    timestep_length: int = -1
+
+    def apply(self, x):
+        f = self.height * self.width * self.channels
+        return x.reshape(-1, self.timestep_length, f)
+
+    def output_type(self, itype):
+        return InputTypeRecurrent(self.height * self.width * self.channels,
+                                  self.timestep_length)
+
+
+@register
+@dataclass
+class RnnToCnnPreProcessor:
+    height: int
+    width: int
+    channels: int
+
+    def apply(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, itype):
+        return InputTypeConvolutional(self.height, self.width, self.channels)
+
+
+def auto_preprocessor(itype, expected: str):
+    """Return (preprocessor|None, new_input_type) adapting ``itype`` to the
+    layer-family input a layer expects (reference InputType auto-insertion)."""
+    if expected == "any":
+        return None, itype
+    if expected == "ff":
+        if isinstance(itype, InputTypeConvolutional):
+            p = CnnToFeedForwardPreProcessor(itype.height, itype.width, itype.channels)
+            return p, p.output_type(itype)
+        if isinstance(itype, InputTypeConvolutionalFlat):
+            return None, InputTypeFeedForward(itype.flat_size())
+        return None, itype
+    if expected == "cnn":
+        if isinstance(itype, InputTypeConvolutionalFlat):
+            p = FeedForwardToCnnPreProcessor(itype.height, itype.width, itype.channels)
+            return p, p.output_type(itype)
+        if isinstance(itype, InputTypeFeedForward):
+            raise ValueError("Cannot feed flat FF input to a CNN layer without "
+                             "an explicit FeedForwardToCnnPreProcessor")
+        return None, itype
+    if expected == "rnn":
+        if isinstance(itype, InputTypeFeedForward):
+            raise ValueError("Cannot feed FF input to an RNN layer without an "
+                             "explicit FeedForwardToRnnPreProcessor")
+        return None, itype
+    return None, itype

@@ -1,0 +1,90 @@
+"""Weight initialization schemes.
+
+Parity with the reference WeightInit enum (reference:
+nn/weights/WeightInit.java:24-42): DISTRIBUTION, ZERO, SIGMOID_UNIFORM,
+UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, XAVIER_LEGACY, RELU,
+RELU_UNIFORM. Distributions for DISTRIBUTION mode mirror nn/conf/distribution/*.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .conf.serde import register
+
+
+@register
+@dataclass(frozen=True)
+class NormalDistribution:
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+@register
+@dataclass(frozen=True)
+class UniformDistribution:
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+
+
+@register
+@dataclass(frozen=True)
+class BinomialDistribution:
+    trials: int = 1
+    p: float = 0.5
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.binomial(rng, self.trials, self.p, shape).astype(dtype)
+
+
+def init_weights(rng, shape: Tuple[int, ...], weight_init: str, fan_in: float,
+                 fan_out: float, dtype=jnp.float32, distribution=None):
+    """Sample an initial weight array.
+
+    ``fan_in``/``fan_out`` are supplied by the layer (e.g. conv uses
+    channels*kernel products, reference ConvolutionParamInitializer).
+    """
+    wi = str(weight_init).lower()
+    if wi == "zero":
+        return jnp.zeros(shape, dtype)
+    if wi == "ones":
+        return jnp.ones(shape, dtype)
+    if wi == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit DISTRIBUTION requires a distribution config")
+        return distribution.sample(rng, shape, dtype)
+    if wi == "uniform":
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if wi == "xavier":
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if wi == "xavier_uniform":
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if wi == "xavier_fan_in":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if wi == "xavier_legacy":
+        std = 1.0 / jnp.sqrt(fan_in + fan_out)
+        return std * jax.random.normal(rng, shape, dtype)
+    if wi == "relu":
+        return jnp.sqrt(2.0 / fan_in) * jax.random.normal(rng, shape, dtype)
+    if wi == "relu_uniform":
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if wi == "sigmoid_uniform":
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if wi == "lecun_normal":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+    raise ValueError(f"Unknown weight init {weight_init!r}")

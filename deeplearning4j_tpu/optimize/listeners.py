@@ -1,0 +1,136 @@
+"""Training listeners: observability SPI + stock implementations.
+
+Reference: optimize/api/IterationListener.java:49,
+optimize/api/TrainingListener.java:23-71 (onEpochStart/End, onForwardPass,
+onGradientCalculation, onBackwardPass); stock impls in optimize/listeners/*
+(ScoreIterationListener, PerformanceListener, CollectScoresIterationListener,
+EvaluativeListener, TimeIterationListener, SleepyTrainingListener).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List, Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float):
+        pass
+
+
+class TrainingListener(IterationListener):
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class CollectScoresIterationListener(TrainingListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput: samples/sec & batches/sec every N iterations (reference
+    optimize/listeners/PerformanceListener.java)."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_samples = report_samples
+        self._last_time = None
+        self._samples = 0
+        self._batches = 0
+        self.history: List[dict] = []
+
+    def note_batch(self, n_samples: int):
+        self._samples += n_samples
+        self._batches += 1
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            return
+        if iteration % self.frequency == 0 and self._batches:
+            dt = max(now - self._last_time, 1e-9)
+            rec = {"iteration": iteration,
+                   "samples_per_sec": self._samples / dt,
+                   "batches_per_sec": self._batches / dt,
+                   "score": float(score)}
+            self.history.append(rec)
+            log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, score=%.5f",
+                     iteration, rec["samples_per_sec"], rec["batches_per_sec"], score)
+            self._last_time = now
+            self._samples = 0
+            self._batches = 0
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (reference TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 100):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, score):
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.perf_counter() - self.start
+            remaining = elapsed / iteration * max(self.total - iteration, 0)
+            log.info("iteration %d/%d, ETA %.0fs", iteration, self.total, remaining)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation against a held-out iterator (reference
+    optimize/listeners/EvaluativeListener.java)."""
+
+    def __init__(self, iterator, frequency: int = 100):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.evaluations: List[Any] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration and iteration % self.frequency == 0:
+            e = model.evaluate(self.iterator)
+            self.evaluations.append(e)
+            log.info("iteration %d eval: accuracy=%.4f", iteration, e.accuracy())
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Throttling listener (reference SleepyTrainingListener) — mainly for
+    testing listener dispatch."""
+
+    def __init__(self, sleep_ms: float = 0.0):
+        self.sleep_ms = sleep_ms
+
+    def iteration_done(self, model, iteration, score):
+        if self.sleep_ms:
+            time.sleep(self.sleep_ms / 1000.0)

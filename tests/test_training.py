@@ -1,0 +1,136 @@
+"""Training-loop tests: updater math vs hand-rolled expectations, convergence
+on a toy problem, listeners, schedules, clipping (SURVEY.md §7 stage 3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners import (CollectScoresIterationListener,
+                                                   PerformanceListener,
+                                                   ScoreIterationListener)
+from deeplearning4j_tpu.optimize.updaters import (Adam, AdaDelta, AdaGrad,
+                                                  AdaMax, MapSchedule,
+                                                  MultiLayerUpdater, Nadam,
+                                                  Nesterovs, NoOp, RmsProp,
+                                                  Sgd, StepSchedule,
+                                                  normalize_gradients)
+
+ALL_RULES = [Sgd(0.1), Adam(1e-2), AdaMax(1e-2), AdaDelta(), Nesterovs(0.1),
+             Nadam(1e-2), AdaGrad(0.1), RmsProp(0.05), NoOp()]
+
+
+def _xor_data(n=200, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y_idx = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    y = np.eye(2, dtype=np.float32)[y_idx]
+    return x, y
+
+
+@pytest.mark.parametrize("rule", ALL_RULES, ids=lambda r: type(r).__name__)
+def test_updater_rules_decrease_loss(rule):
+    x, y = _xor_data(128)
+    conf = (NeuralNetConfiguration(seed=7, updater=rule, weight_init="xavier")
+            .list(DenseLayer(n_in=2, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=30 if isinstance(rule, (NoOp, AdaDelta)) else 15,
+            batch_size=64)
+    s1 = net.score(x, y)
+    assert s1 < s0, f"{type(rule).__name__}: {s0} -> {s1}"
+
+
+def test_sgd_matches_manual_math():
+    """One SGD step must equal p - lr*grad exactly."""
+    x, y = _xor_data(16)
+    conf = (NeuralNetConfiguration(seed=3, updater=Sgd(0.5))
+            .list(DenseLayer(n_in=2, n_out=4, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    params0 = jax.tree.map(lambda a: np.asarray(a), net.params)
+
+    def lf(p):
+        return net.loss_fn(p, net.state, jnp.asarray(x), jnp.asarray(y),
+                           train=False)[0]
+    grads = jax.grad(lf)(net.params)
+    net.fit(x, y, epochs=1, batch_size=16)
+    for p0, g, p1 in zip(params0, grads, net.params):
+        for k in p0:
+            # dropout off => train/eval forward identical; exact match expected
+            assert np.allclose(np.asarray(p1[k]), p0[k] - 0.5 * np.asarray(g[k]),
+                               atol=1e-6), k
+
+
+def test_adam_single_step_math():
+    rule = Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    g = jnp.array([1.0, -2.0])
+    s = rule.init_one(g)
+    upd, s2 = rule.update_one(g, s, 0.1, 0)
+    m = 0.1 * np.array([1.0, -2.0])
+    v = 0.001 * np.array([1.0, 4.0])
+    mhat, vhat = m / 0.1, v / 0.001
+    expect = 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    assert np.allclose(np.asarray(upd), expect, atol=1e-7)
+
+
+def test_schedules():
+    step_sched = StepSchedule(decay_rate=0.5, step_size=10)
+    assert float(step_sched(1.0, 0)) == 1.0
+    assert float(step_sched(1.0, 10)) == 0.5
+    assert float(step_sched(1.0, 25)) == 0.25
+    m = MapSchedule({"0": 1.0, "5": 0.1, "20": 0.01})
+    assert float(m(1.0, 3)) == 1.0
+    assert float(m(1.0, 7)) == pytest.approx(0.1)
+    assert float(m(1.0, 30)) == pytest.approx(0.01)
+
+
+def test_gradient_clipping_modes():
+    grads = ({"W": jnp.array([[3.0, -4.0]]), "b": jnp.array([10.0])},)
+    out = normalize_gradients(grads, "clipelementwiseabsolutevalue", 2.0)
+    assert float(jnp.max(jnp.abs(out[0]["W"]))) <= 2.0
+    assert float(out[0]["b"][0]) == 2.0
+    out = normalize_gradients(grads, "clipl2perparamtype", 1.0)
+    assert float(jnp.linalg.norm(out[0]["W"])) <= 1.0 + 1e-5
+    out = normalize_gradients(grads, "renormalizel2perlayer", 1.0)
+    total = np.sqrt(sum(float(jnp.sum(v * v)) for v in out[0].values()))
+    assert abs(total - 1.0) < 1e-5
+
+
+def test_xor_convergence_and_listeners():
+    x, y = _xor_data(512)
+    conf = (NeuralNetConfiguration(seed=11, updater=Adam(5e-3))
+            .list(DenseLayer(n_in=2, n_out=32, activation="relu"),
+                  DenseLayer(n_out=32, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    scores = CollectScoresIterationListener()
+    perf = PerformanceListener(frequency=5)
+    net.set_listeners(scores, perf, ScoreIterationListener(50))
+    net.fit(x, y, epochs=60, batch_size=128)
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.95, ev.stats()
+    assert len(scores.scores) > 100
+    assert scores.scores[-1][1] < scores.scores[0][1]
+    assert perf.history and perf.history[-1]["samples_per_sec"] > 0
+
+
+def test_masked_training():
+    x, y = _xor_data(64)
+    mask = np.ones((64,), np.float32)
+    mask[32:] = 0.0  # second half ignored
+    ds = DataSet(x, y, labels_mask=mask)
+    conf = (NeuralNetConfiguration(seed=5, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=2, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(iterator=ListDataSetIterator([ds]), epochs=2)
+    assert np.all(np.isfinite(np.asarray(net.params_flat())))

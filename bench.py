@@ -1,0 +1,152 @@
+"""Benchmark: ResNet-50 training throughput (img/sec/chip) — BASELINE #2.
+
+Compares this framework's ResNet-50 (zoo model + jitted solver step) against
+an independent reference implementation (flax.linen ResNet-50 + optax),
+both on the same device with the same batch/dtype. The BASELINE.md target is
+>= 0.70 x reference; ``vs_baseline`` reports ours/reference.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/sec", "vs_baseline": N}
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMG = int(os.environ.get("BENCH_IMG", "128"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+WARMUP = 3
+
+
+def _time_steps(step_fn, args, steps):
+    """args: list of donated-loop state; step_fn returns new state tuple."""
+    state = args
+    for _ in range(WARMUP):
+        state = step_fn(*state)
+    import jax
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step_fn(*state)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_ours():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+    net = resnet50(n_classes=1000, height=IMG, width=IMG, channels=3,
+                   updater=Nesterovs(0.1, momentum=0.9)).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, IMG, IMG, 3)), jnp.float32)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)])
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(params, state, opt_state, it, key):
+        def lf(p):
+            return net.loss_fn(p, state, x, y, train=True, rng=key)
+        (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+        return new_params, new_state, new_opt, it + 1, key
+
+    dt = _time_steps(step, [net.params, net.state, net.opt_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
+                     STEPS)
+    return BATCH / dt
+
+
+def bench_reference():
+    """Independent flax.linen ResNet-50 + optax SGD-momentum."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    import optax
+
+    class Bottleneck(nn.Module):
+        filters: int
+        stride: int = 1
+        project: bool = False
+
+        @nn.compact
+        def __call__(self, x, train):
+            r = x
+            y = nn.Conv(self.filters, (1, 1), (self.stride, self.stride),
+                        use_bias=False)(x)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            if self.project:
+                r = nn.Conv(self.filters * 4, (1, 1),
+                            (self.stride, self.stride), use_bias=False)(x)
+                r = nn.BatchNorm(use_running_average=not train)(r)
+            return nn.relu(y + r)
+
+    class ResNet50(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(64, (7, 7), (2, 2), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+            for i, (f, blocks, s) in enumerate([(64, 3, 1), (128, 4, 2),
+                                                (256, 6, 2), (512, 3, 2)]):
+                x = Bottleneck(f, s, project=True)(x, train)
+                for _ in range(blocks - 1):
+                    x = Bottleneck(f)(x, train)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(1000)(x)
+
+    model = ResNet50()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, IMG, IMG, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 1000, BATCH))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(params, batch_stats, opt_state):
+        def lf(p):
+            logits, mut = model.apply({"params": p, "batch_stats": batch_stats},
+                                      x, train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, mut["batch_stats"]
+        (loss, new_bs), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_bs, new_opt
+
+    dt = _time_steps(step, [params, batch_stats, opt_state], STEPS)
+    return BATCH / dt
+
+
+def main():
+    ours = bench_ours()
+    try:
+        ref = bench_reference()
+    except Exception as e:
+        print(f"reference bench failed: {e}", file=sys.stderr)
+        ref = None
+    ratio = (ours / ref) if ref else None
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(ours, 2),
+        "unit": "img/sec",
+        "vs_baseline": round(ratio, 3) if ratio else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+from .kmeans import KMeansClustering
+from .tsne import Tsne
+from .vptree import VPTree
+
+__all__ = ["KMeansClustering", "Tsne", "VPTree"]

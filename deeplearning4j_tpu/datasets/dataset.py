@@ -23,7 +23,8 @@ class DataSet:
     labels_mask: Optional[np.ndarray] = None
 
     def num_examples(self) -> int:
-        return int(self.features.shape[0])
+        f = self.features[0] if isinstance(self.features, (list, tuple)) else self.features
+        return int(f.shape[0])
 
 
 class DataSetIterator:

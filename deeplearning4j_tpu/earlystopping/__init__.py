@@ -1,0 +1,12 @@
+from .early_stopping import (BestScoreEpochTerminationCondition,
+                             DataSetLossCalculator, EarlyStoppingConfiguration,
+                             EarlyStoppingResult, EarlyStoppingTrainer,
+                             InMemoryModelSaver,
+                             InvalidScoreIterationTerminationCondition,
+                             LocalFileModelSaver,
+                             MaxEpochsTerminationCondition,
+                             MaxScoreIterationTerminationCondition,
+                             MaxTimeIterationTerminationCondition,
+                             ScoreImprovementEpochTerminationCondition)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,294 @@
+"""Keras HDF5 model import.
+
+Reference: deeplearning4j-modelimport — KerasModelImport.java:48-231 (entry
+overloads), KerasModel.java:418 (config translation), :510-523 (weight copy),
+per-layer translators layers/Keras* (name registry KerasLayer.java:48-70),
+Hdf5Archive.java:22-35 (native HDF5 read — h5py here plays the role of the
+JavaCPP hdf5 binding; SURVEY.md §2.6.3).
+
+Supports the Keras-1.x-era surface the reference covers: Sequential and
+functional Model configs with Dense, Conv2D(Convolution2D), MaxPooling2D,
+AveragePooling2D, Flatten, Dropout, Activation, BatchNormalization, LSTM,
+Embedding, ZeroPadding2D, Merge/Add/Concatenate, GlobalAveragePooling2D,
+GlobalMaxPooling2D. Both 'th' (channels-first) and 'tf' dim orderings; our
+runtime layout is NHWC, so 'th' kernels are transposed at import
+(the analogue of the reference's TensorFlowCnnToFeedForwardPreProcessor).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..nn.conf.config import NeuralNetConfiguration
+from ..nn.inputs import InputType
+from ..nn.layers import (ActivationLayer, BatchNormalization, ConvolutionLayer,
+                         DenseLayer, DropoutLayer, EmbeddingLayer,
+                         GlobalPoolingLayer, LSTM, OutputLayer,
+                         SubsamplingLayer, ZeroPaddingLayer)
+from ..nn.multilayer import MultiLayerNetwork
+
+_ACT_MAP = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "softmax": "softmax", "tanh": "tanh", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "elu": "elu",
+    "selu": "selu",
+}
+
+_LOSS_MAP = {
+    "categorical_crossentropy": "mcxent", "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mean_absolute_error", "mae": "mean_absolute_error",
+    "kullback_leibler_divergence": "kl_divergence", "poisson": "poisson",
+    "cosine_proximity": "cosine_proximity", "hinge": "hinge",
+    "squared_hinge": "squared_hinge",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+}
+
+
+def _keras_act(cfg, default="identity"):
+    a = cfg.get("activation", default) or default
+    if a not in _ACT_MAP:
+        raise ValueError(f"Unsupported Keras activation {a!r}")
+    return _ACT_MAP[a]
+
+
+class KerasLayerTranslator:
+    """Translate one Keras layer config dict -> our layer conf (or None for
+    structural layers like Flatten/InputLayer, which our InputType system
+    absorbs)."""
+
+    def __init__(self, dim_ordering: str = "tf"):
+        self.dim_ordering = dim_ordering
+
+    def translate(self, klass: str, cfg: Dict[str, Any], is_output: bool,
+                  loss: Optional[str]):
+        if klass in ("InputLayer", "Flatten", "Reshape"):
+            return None
+        if klass == "Dense":
+            n_out = cfg.get("output_dim") or cfg.get("units")
+            act = _keras_act(cfg)
+            if is_output:
+                return OutputLayer(n_out=int(n_out), activation=act,
+                                   loss=_LOSS_MAP.get(loss or "", "mcxent"))
+            return DenseLayer(n_out=int(n_out), activation=act)
+        if klass in ("Convolution2D", "Conv2D"):
+            n_out = cfg.get("nb_filter") or cfg.get("filters")
+            if "nb_row" in cfg:
+                k = (cfg["nb_row"], cfg["nb_col"])
+            else:
+                k = tuple(cfg["kernel_size"])
+            stride = tuple(cfg.get("subsample") or cfg.get("strides") or (1, 1))
+            border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+            mode = "same" if border == "same" else "truncate"
+            return ConvolutionLayer(n_out=int(n_out), kernel_size=k, stride=stride,
+                                    convolution_mode=mode, activation=_keras_act(cfg))
+        if klass in ("MaxPooling2D", "AveragePooling2D"):
+            pt = "max" if klass.startswith("Max") else "avg"
+            k = tuple(cfg.get("pool_size") or (2, 2))
+            s = tuple(cfg.get("strides") or k)
+            border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+            return SubsamplingLayer(pooling_type=pt, kernel_size=k, stride=s,
+                                    convolution_mode="same" if border == "same"
+                                    else "truncate")
+        if klass in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+            return GlobalPoolingLayer(pooling_type="avg" if "Average" in klass
+                                      else "max")
+        if klass == "Dropout":
+            p = cfg.get("p") or cfg.get("rate") or 0.5
+            return DropoutLayer(dropout=1.0 - float(p))  # keras p = drop prob
+        if klass == "Activation":
+            return ActivationLayer(activation=_keras_act(cfg))
+        if klass == "BatchNormalization":
+            return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
+                                      decay=float(cfg.get("momentum", 0.9)))
+        if klass == "ZeroPadding2D":
+            pad = cfg.get("padding") or (1, 1)
+            if isinstance(pad, (list, tuple)) and len(pad) == 2 and \
+                    not isinstance(pad[0], (list, tuple)):
+                return ZeroPaddingLayer(padding=tuple(pad))
+            (t, b), (l, r) = pad
+            return ZeroPaddingLayer(padding=(t, b, l, r))
+        if klass == "LSTM":
+            n_out = cfg.get("output_dim") or cfg.get("units")
+            return LSTM(n_out=int(n_out), activation=_keras_act(cfg, "tanh"),
+                        gate_activation=_ACT_MAP.get(
+                            cfg.get("inner_activation") or
+                            cfg.get("recurrent_activation") or "sigmoid",
+                            "sigmoid"))
+        if klass == "Embedding":
+            return EmbeddingLayer(n_in=int(cfg["input_dim"]),
+                                  n_out=int(cfg.get("output_dim") or cfg["units"]))
+        raise ValueError(f"Unsupported Keras layer class {klass!r} "
+                         f"(reference registry KerasLayer.java:48-70)")
+
+
+def _input_type_from(cfg: Dict[str, Any], dim_ordering: str):
+    shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        if dim_ordering == "th":
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    return None
+
+
+def _collect_weights(f, layer_names):
+    """h5 'model_weights'/<layer>/<param datasets> -> {layer: [arrays]}"""
+    g = f["model_weights"] if "model_weights" in f else f
+    out = {}
+    for name in layer_names:
+        if name not in g:
+            continue
+        lg = g[name]
+        wn = [n.decode() if isinstance(n, bytes) else n
+              for n in lg.attrs.get("weight_names", [])]
+        if len(wn):
+            arrays = [np.array(lg[n]) for n in wn]
+        else:
+            arrays = [np.array(lg[k]) for k in sorted(lg.keys())]
+        if arrays:
+            out[name] = arrays
+    return out
+
+
+def _convert_lstm_weights(arrays, H):
+    """Keras-1 LSTM: 12 arrays (W,U,b per gate, order i,c,f,o in keras1 /
+    i,f,c,o in some versions) or Keras-2 fused (W[in,4H], U[H,4H], b[4H],
+    gate order i,f,c,o). Our packed order is [i,f,o,g]."""
+    if len(arrays) == 3:
+        W, U, b = arrays
+        def reorder(m):
+            i, f, c, o = np.split(m, 4, axis=-1)
+            return np.concatenate([i, f, o, c], axis=-1)
+        return {"W": reorder(W), "R": reorder(U), "b": reorder(b)}
+    if len(arrays) == 12:
+        # keras1 order: W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o
+        Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = arrays
+        return {"W": np.concatenate([Wi, Wf, Wo, Wc], axis=-1),
+                "R": np.concatenate([Ui, Uf, Uo, Uc], axis=-1),
+                "b": np.concatenate([bi, bf, bo, bc], axis=-1)}
+    raise ValueError(f"Unexpected LSTM weight count {len(arrays)}")
+
+
+def import_keras_sequential_model_and_weights(path: str, *, enforce_training_config=False
+                                              ) -> MultiLayerNetwork:
+    """Reference KerasModelImport.importKerasSequentialModelAndWeights."""
+    import h5py
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise ValueError(f"{path} has no model_config attribute")
+        model_cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
+        training_cfg = f.attrs.get("training_config")
+        loss = None
+        if training_cfg is not None:
+            tc = json.loads(training_cfg if isinstance(training_cfg, str)
+                            else training_cfg.decode())
+            loss = tc.get("loss")
+        if model_cfg.get("class_name") != "Sequential":
+            raise ValueError("Use import_keras_model_and_weights for functional models")
+        layer_cfgs = model_cfg["config"]
+        if isinstance(layer_cfgs, dict):
+            layer_cfgs = layer_cfgs["layers"]
+
+        dim_ordering = "tf"
+        for lc in layer_cfgs:
+            if "dim_ordering" in lc.get("config", {}):
+                dim_ordering = lc["config"]["dim_ordering"]
+                break
+        tr = KerasLayerTranslator(dim_ordering)
+        confs, keras_names, keras_classes = [], [], []
+        itype = None
+        for i, lc in enumerate(layer_cfgs):
+            cfg = lc.get("config", {})
+            if itype is None:
+                it = _input_type_from(cfg, dim_ordering)
+                if it is not None:
+                    itype = it
+            is_out = i == len(layer_cfgs) - 1
+            conf = tr.translate(lc["class_name"], cfg, is_out, loss)
+            if conf is not None:
+                confs.append(conf)
+                keras_names.append(cfg.get("name") or lc.get("name"))
+                keras_classes.append(lc["class_name"])
+        b = NeuralNetConfiguration(seed=12345, activation="identity",
+                                   weight_init="xavier").list(*confs)
+        if itype is not None:
+            b = b.set_input_type(itype)
+        net = MultiLayerNetwork(b.build()).init()
+
+        weights = _collect_weights(f, [n for n in keras_names if n])
+        _copy_weights_mln(net, keras_names, keras_classes, weights, dim_ordering)
+    return net
+
+
+def _copy_weights_mln(net, keras_names, keras_classes, weights, dim_ordering):
+    params = [dict(p) for p in net.params]
+    state = [dict(s) for s in net.state]
+    for li, (kname, kclass) in enumerate(zip(keras_names, keras_classes)):
+        if kname not in weights:
+            continue
+        arrays = weights[kname]
+        layer = net.layers[li]
+        from ..nn.layers import (BatchNormalization, ConvolutionLayer,
+                                 DenseLayer, EmbeddingLayer, LSTM, OutputLayer)
+        if isinstance(layer, (ConvolutionLayer,)):
+            W = arrays[0]
+            if W.ndim == 4 and dim_ordering == "th":
+                W = W.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            params[li]["W"] = np_cast(W, params[li]["W"])
+            if len(arrays) > 1:
+                params[li]["b"] = np_cast(arrays[1], params[li]["b"])
+        elif isinstance(layer, LSTM):
+            conv = _convert_lstm_weights(arrays, layer.n_out)
+            for k, v in conv.items():
+                params[li][k] = np_cast(v, params[li][k])
+        elif isinstance(layer, BatchNormalization):
+            # keras order: gamma, beta, running_mean, running_var
+            params[li]["gamma"] = np_cast(arrays[0], params[li]["gamma"])
+            params[li]["beta"] = np_cast(arrays[1], params[li]["beta"])
+            if len(arrays) >= 4:
+                state[li]["mean"] = np_cast(arrays[2], state[li]["mean"])
+                state[li]["var"] = np_cast(arrays[3], state[li]["var"])
+        elif isinstance(layer, (DenseLayer, OutputLayer, EmbeddingLayer)):
+            params[li]["W"] = np_cast(arrays[0], params[li]["W"])
+            if len(arrays) > 1 and "b" in params[li]:
+                params[li]["b"] = np_cast(arrays[1], params[li]["b"])
+    import jax.numpy as jnp
+    net.params = tuple(params)
+    net.state = tuple(state)
+    net.opt_state = net.updater.init(net.params)
+
+
+def np_cast(src, like):
+    import jax.numpy as jnp
+    src = np.asarray(src)
+    if src.shape != like.shape:
+        raise ValueError(f"Weight shape mismatch: keras {src.shape} vs "
+                         f"model {like.shape}")
+    return jnp.asarray(src, like.dtype)
+
+
+def import_keras_model(path: str):
+    """Reference KerasModelImport.importKerasModelAndWeights: sniff
+    Sequential vs functional."""
+    import h5py
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise ValueError(f"{path}: no model_config")
+        cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
+    if cfg.get("class_name") == "Sequential":
+        return import_keras_sequential_model_and_weights(path)
+    raise NotImplementedError("Functional Keras model import lands next round "
+                              "(reference KerasModel.java:418)")

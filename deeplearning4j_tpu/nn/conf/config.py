@@ -164,6 +164,9 @@ class ListBuilder:
             n_in = getattr(first, "n_in", None)
             if n_in:
                 itype = InputTypeFeedForward(n_in)
+                # record it so init()-time shape inference (e.g. BatchNorm
+                # feature-count) sees the same chain build() used
+                self._input_type = itype
         resolved, preprocs = [], {}
         for i, layer in enumerate(self.layers):
             layer = nc._cascade(layer)

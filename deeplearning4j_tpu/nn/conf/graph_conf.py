@@ -1,0 +1,149 @@
+"""ComputationGraph configuration + GraphBuilder.
+
+Reference: nn/conf/ComputationGraphConfiguration.java (755 LoC; GraphBuilder
+addInputs/addLayer/addVertex/setOutputs/setInputTypes/build), topological
+validation, JSON round-trip.
+
+The topological sort happens once at build time (the reference sorts at
+network init, ComputationGraph.java:1138); the executor traces vertices in
+that fixed order so XLA sees one static DAG.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import serde
+from .serde import register
+from ..graph.vertices import LayerVertex, VertexConf
+from ..preprocessors import auto_preprocessor
+
+
+@register
+@dataclass
+class ComputationGraphConfiguration:
+    network_inputs: List[str] = field(default_factory=list)
+    network_outputs: List[str] = field(default_factory=list)
+    vertex_names: List[str] = field(default_factory=list)          # topo order
+    vertices: Dict[str, Any] = field(default_factory=dict)         # name -> VertexConf
+    vertex_inputs: Dict[str, List[str]] = field(default_factory=dict)
+    input_types: Optional[List[Any]] = None
+    seed: int = 12345
+    dtype: str = "float32"
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_bwd_length: int = 20
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    updater: Optional[Any] = None
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return serde.from_json(s)
+
+
+def topological_sort(names, inputs_of, network_inputs):
+    """Kahn's algorithm over the vertex dependency graph (reference
+    ComputationGraph.java:1138 topologicalSortOrder)."""
+    remaining = {n: [i for i in inputs_of[n] if i not in network_inputs]
+                 for n in names}
+    order, ready = [], [n for n, deps in remaining.items() if not deps]
+    consumers: Dict[str, List[str]] = {}
+    for n in names:
+        for i in remaining[n]:
+            consumers.setdefault(i, []).append(n)
+    ready = sorted(ready)
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for c in consumers.get(n, []):
+            remaining[c].remove(n)
+            if not remaining[c]:
+                ready.append(c)
+    if len(order) != len(names):
+        cyc = sorted(set(names) - set(order))
+        raise ValueError(f"Graph has a cycle or missing inputs involving {cyc}")
+    return order
+
+
+class GraphBuilder:
+    """Reference ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, nn_conf):
+        self.nn_conf = nn_conf
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._vertices: Dict[str, VertexConf] = {}
+        self._vertex_inputs: Dict[str, List[str]] = {}
+        self._input_types: Optional[List[Any]] = None
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def add_layer(self, name: str, layer, *inputs: str, preprocessor=None) -> "GraphBuilder":
+        layer = self.nn_conf._cascade(layer)
+        self._vertices[name] = LayerVertex(layer_conf=layer, preprocessor=preprocessor)
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: VertexConf, *inputs: str) -> "GraphBuilder":
+        self._vertices[name] = vertex
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def set_input_types(self, *itypes) -> "GraphBuilder":
+        self._input_types = list(itypes)
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        for name, ins in self._vertex_inputs.items():
+            for i in ins:
+                if i not in self._inputs and i not in self._vertices:
+                    raise ValueError(f"Vertex {name!r} references unknown input {i!r}")
+        for o in self._outputs:
+            if o not in self._vertices:
+                raise ValueError(f"Unknown output vertex {o!r}")
+        if not self._outputs:
+            raise ValueError("setOutputs(...) required")
+        order = topological_sort(list(self._vertices), self._vertex_inputs, self._inputs)
+
+        # shape inference + nIn setting + auto preprocessor insertion
+        if self._input_types is not None:
+            itypes: Dict[str, Any] = dict(zip(self._inputs, self._input_types))
+            for name in order:
+                v = self._vertices[name]
+                in_types = [itypes[i] for i in self._vertex_inputs[name]]
+                if isinstance(v, LayerVertex):
+                    if v.preprocessor is None:
+                        pre, new_it = auto_preprocessor(in_types[0],
+                                                        v.layer_conf.expected_input)
+                        if pre is not None:
+                            v.preprocessor = pre
+                        in_types = [new_it] + in_types[1:]
+                    else:
+                        in_types = [v.preprocessor.output_type(in_types[0])] + in_types[1:]
+                    if getattr(v.layer_conf, "n_in", "absent") is None:
+                        from .config import _infer_n_in
+                        v.layer_conf.n_in = _infer_n_in(v.layer_conf, in_types[0])
+                    itypes[name] = v.layer_conf.output_type(in_types[0])
+                else:
+                    itypes[name] = v.output_type(in_types)
+
+        nc = self.nn_conf
+        return ComputationGraphConfiguration(
+            network_inputs=list(self._inputs), network_outputs=list(self._outputs),
+            vertex_names=order, vertices=dict(self._vertices),
+            vertex_inputs=dict(self._vertex_inputs),
+            input_types=self._input_types, seed=nc.seed, dtype=nc.dtype,
+            gradient_normalization=nc.gradient_normalization,
+            gradient_normalization_threshold=nc.gradient_normalization_threshold,
+            updater=nc.updater)

@@ -1,0 +1,249 @@
+"""Graph vertices: the DAG building blocks.
+
+Reference: nn/graph/vertex/GraphVertex.java SPI + impls in
+nn/graph/vertex/impl/ (LayerVertex, MergeVertex, ElementWiseVertex,
+SubsetVertex, StackVertex, UnstackVertex, ScaleVertex, ShiftVertex, L2Vertex,
+L2NormalizeVertex, PreprocessorVertex, rnn/{LastTimeStepVertex,
+DuplicateToTimeSeriesVertex}); config mirror in nn/conf/graph/*.
+
+Here config and impl are one dataclass (like layers): ``apply(params, state,
+inputs, ...)`` over a LIST of input arrays, pure; shape inference via
+``output_type(input_types)``. Everything is trace-time static, so the whole
+DAG fuses into one XLA program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+from ..inputs import (InputTypeConvolutional, InputTypeFeedForward,
+                      InputTypeRecurrent)
+
+
+@dataclass
+class VertexConf:
+    """Base vertex. ``n_params`` vertices override init/param plumbing."""
+
+    def output_type(self, itypes: List[Any]):
+        return itypes[0]
+
+    def init(self, rng, itypes, dtype):
+        return {}, {}
+
+    def apply(self, params, state, inputs: List[Any], *, train=False, rng=None):
+        raise NotImplementedError
+
+    @property
+    def layer(self):
+        return None
+
+
+@register
+@dataclass
+class LayerVertex(VertexConf):
+    """Wraps a layer conf (+ optional explicit preprocessor)."""
+    layer_conf: Any = None
+    preprocessor: Optional[Any] = None
+
+    @property
+    def layer(self):
+        return self.layer_conf
+
+    def output_type(self, itypes):
+        it = itypes[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer_conf.output_type(it)
+
+    def init(self, rng, itypes, dtype):
+        it = itypes[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer_conf.init(rng, it, dtype)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        x = inputs[0]
+        if self.preprocessor is not None:
+            x = self.preprocessor.apply(x)
+        return self.layer_conf.apply(params, state, x, train=train, rng=rng)
+
+
+@register
+@dataclass
+class MergeVertex(VertexConf):
+    """Concatenate along the feature (last) axis (reference MergeVertex —
+    NCHW depth concat becomes NHWC channel concat here)."""
+
+    def output_type(self, itypes):
+        it0 = itypes[0]
+        if isinstance(it0, InputTypeConvolutional):
+            return InputTypeConvolutional(it0.height, it0.width,
+                                          sum(i.channels for i in itypes))
+        if isinstance(it0, InputTypeRecurrent):
+            return InputTypeRecurrent(sum(i.size for i in itypes), it0.timestep_length)
+        return InputTypeFeedForward(sum(i.size for i in itypes))
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return jnp.concatenate(inputs, axis=-1), state
+
+
+@register
+@dataclass
+class ElementWiseVertex(VertexConf):
+    """Elementwise add/subtract/product/average/max (reference ElementWiseVertex)."""
+    op: str = "add"
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        op = self.op.lower()
+        if op == "add":
+            out = sum(inputs[1:], inputs[0])
+        elif op == "subtract":
+            out = inputs[0] - inputs[1]
+        elif op in ("product", "mult"):
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = out * v
+        elif op in ("average", "avg"):
+            out = sum(inputs[1:], inputs[0]) / len(inputs)
+        elif op == "max":
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = jnp.maximum(out, v)
+        else:
+            raise ValueError(f"Unknown elementwise op {self.op!r}")
+        return out, state
+
+
+@register
+@dataclass
+class SubsetVertex(VertexConf):
+    """Feature-range slice [from, to] inclusive (reference SubsetVertex)."""
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def output_type(self, itypes):
+        n = self.to_idx - self.from_idx + 1
+        it = itypes[0]
+        if isinstance(it, InputTypeRecurrent):
+            return InputTypeRecurrent(n, it.timestep_length)
+        return InputTypeFeedForward(n)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return inputs[0][..., self.from_idx:self.to_idx + 1], state
+
+
+@register
+@dataclass
+class StackVertex(VertexConf):
+    """Stack along batch dim (reference StackVertex — used for sharing one
+    layer across several inputs)."""
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return jnp.concatenate(inputs, axis=0), state
+
+
+@register
+@dataclass
+class UnstackVertex(VertexConf):
+    """Inverse of StackVertex: take stack slice ``from_idx`` of ``stack_size``."""
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step:(self.from_idx + 1) * step], state
+
+
+@register
+@dataclass
+class ScaleVertex(VertexConf):
+    scale_factor: float = 1.0
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return inputs[0] * self.scale_factor, state
+
+
+@register
+@dataclass
+class ShiftVertex(VertexConf):
+    shift_factor: float = 0.0
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return inputs[0] + self.shift_factor, state
+
+
+@register
+@dataclass
+class L2NormalizeVertex(VertexConf):
+    eps: float = 1e-8
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + self.eps)
+        return x / norm, state
+
+
+@register
+@dataclass
+class L2Vertex(VertexConf):
+    """Pairwise L2 distance between two inputs (reference L2Vertex)."""
+    eps: float = 1e-8
+
+    def output_type(self, itypes):
+        return InputTypeFeedForward(1)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        d = inputs[0] - inputs[1]
+        return jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True) + self.eps), state
+
+
+@register
+@dataclass
+class PreprocessorVertex(VertexConf):
+    preprocessor: Any = None
+
+    def output_type(self, itypes):
+        return self.preprocessor.output_type(itypes[0])
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return self.preprocessor.apply(inputs[0]), state
+
+
+@register
+@dataclass
+class LastTimeStepVertex(VertexConf):
+    """[B,T,F] -> [B,F] at the last unmasked step (reference
+    rnn/LastTimeStepVertex). With no mask: the literal last step."""
+    mask_input: Optional[str] = None
+
+    def output_type(self, itypes):
+        return InputTypeFeedForward(itypes[0].size)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        x = inputs[0]
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx], state
+        return x[:, -1], state
+
+
+@register
+@dataclass
+class DuplicateToTimeSeriesVertex(VertexConf):
+    """[B,F] -> [B,T,F] broadcast over time; T taken from a reference input
+    (reference rnn/DuplicateToTimeSeriesVertex)."""
+    reference_input: Optional[str] = None
+    timestep_length: int = -1
+
+    def output_type(self, itypes):
+        return InputTypeRecurrent(itypes[0].size, self.timestep_length)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, timesteps=None):
+        x = inputs[0]
+        t = timesteps if timesteps is not None else self.timestep_length
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1])), state

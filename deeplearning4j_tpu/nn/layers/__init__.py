@@ -1,0 +1,18 @@
+from .base import LayerConf
+from .core import (ActivationLayer, AutoEncoder, CenterLossOutputLayer,
+                   DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
+                   OutputLayer, RnnOutputLayer)
+from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
+                   SubsamplingLayer, Subsampling1DLayer, ZeroPaddingLayer)
+from .norm import BatchNormalization, LocalResponseNormalization
+from .recurrent import (GravesBidirectionalLSTM, GravesLSTM, LSTM,
+                        LastTimeStepLayer)
+
+__all__ = [
+    "LayerConf", "ActivationLayer", "AutoEncoder", "CenterLossOutputLayer",
+    "DenseLayer", "DropoutLayer", "EmbeddingLayer", "LossLayer", "OutputLayer",
+    "RnnOutputLayer", "Convolution1DLayer", "ConvolutionLayer",
+    "GlobalPoolingLayer", "SubsamplingLayer", "Subsampling1DLayer",
+    "ZeroPaddingLayer", "BatchNormalization", "LocalResponseNormalization",
+    "GravesBidirectionalLSTM", "GravesLSTM", "LSTM", "LastTimeStepLayer",
+]

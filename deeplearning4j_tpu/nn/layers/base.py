@@ -55,6 +55,7 @@ class LayerConf:
     updater: Optional[Any] = None             # per-layer IUpdater override
     learning_rate: Optional[float] = None
     bias_learning_rate: Optional[float] = None
+    frozen: bool = False                      # reference misc/FrozenLayer: no updates
 
     # --- class-level metadata overridden by subclasses (not serialized) ---
     param_order: ClassVar[Tuple[str, ...]] = ()

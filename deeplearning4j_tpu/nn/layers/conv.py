@@ -1,0 +1,291 @@
+"""Convolution + pooling + padding layers (NHWC, TPU-native).
+
+Reference parity:
+- ConvolutionLayer   -> nn/conf/layers/ConvolutionLayer.java +
+  nn/layers/convolution/ConvolutionLayer.java (im2col+gemm fallback :181-197,
+  cuDNN helper probe :72). Here the conv IS the accelerated path:
+  lax.conv_general_dilated lowers straight onto the MXU — the helper seam the
+  reference needed for cuDNN is replaced by XLA lowering (SURVEY.md §2.6.2).
+- Convolution1DLayer -> nn/conf/layers/Convolution1DLayer.java (NWC).
+- SubsamplingLayer   -> nn/layers/convolution/subsampling/* (MAX/AVG/PNORM/SUM)
+- Subsampling1DLayer
+- ZeroPaddingLayer   -> nn/conf/layers/ZeroPaddingLayer.java
+- SpaceToDepth-style reshapes are covered by preprocessors.
+
+ConvolutionMode semantics (reference nn/conf/ConvolutionMode.java):
+"strict"/"truncate" = VALID with explicit padding; "same" = SAME (stride-aware).
+
+Layouts: NHWC / HWIO — channels ride the 128-lane minor dimension; bf16-ready.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.serde import register
+from ..inputs import InputTypeConvolutional, InputTypeRecurrent
+from .base import LayerConf, maybe_dropout
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv_output_size(size, k, s, p, mode):
+    if mode == "same":
+        return -(-size // s)  # ceil
+    return (size + 2 * p - k) // s + 1
+
+
+@register
+@dataclass
+class ConvolutionLayer(LayerConf):
+    n_in: Optional[int] = None            # input channels (inferred)
+    n_out: int = 0                        # output channels
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"    # strict | truncate | same
+    dilation: Tuple[int, int] = (1, 1)
+    cudnn_algo_mode: Optional[str] = None  # accepted no-op (XLA autotunes; SURVEY §2.6.8)
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b")
+    expected_input: ClassVar[str] = "cnn"
+
+    def _geom(self):
+        return _pair(self.kernel_size), _pair(self.stride), _pair(self.padding), _pair(self.dilation)
+
+    def output_type(self, itype):
+        (kh, kw), (sh, sw), (ph, pw), _ = self._geom()
+        mode = self.convolution_mode
+        h = conv_output_size(itype.height, kh, sh, ph, mode)
+        w = conv_output_size(itype.width, kw, sw, pw, mode)
+        return InputTypeConvolutional(h, w, self.n_out)
+
+    def init(self, rng, itype, dtype):
+        (kh, kw), _, _, _ = self._geom()
+        c_in = self.n_in if self.n_in else itype.channels
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * self.n_out
+        W = self._winit(rng, (kh, kw, c_in, self.n_out), fan_in, fan_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype)}, {}
+
+    def pre_output(self, params, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = self._geom()
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = [(ph, ph), (pw, pw)]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(sh, sw), padding=pad,
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        return (y + params["b"]).astype(x.dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.act(self.pre_output(params, x, train=train, rng=rng)), state
+
+
+@register
+@dataclass
+class Convolution1DLayer(LayerConf):
+    """Temporal convolution over [B,T,F] (reference Convolution1DLayer)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "same"
+    dilation: int = 1
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b")
+    expected_input: ClassVar[str] = "rnn"
+
+    def output_type(self, itype):
+        t = itype.timestep_length
+        if t and t > 0:
+            t = conv_output_size(t, self.kernel_size, self.stride, self.padding,
+                                 self.convolution_mode)
+        return InputTypeRecurrent(self.n_out, t)
+
+    def init(self, rng, itype, dtype):
+        c_in = self.n_in if self.n_in else itype.size
+        fan_in = self.kernel_size * c_in
+        fan_out = self.kernel_size * self.n_out
+        W = self._winit(rng, (self.kernel_size, c_in, self.n_out), fan_in, fan_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype)}, {}
+
+    def pre_output(self, params, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        pad = "SAME" if self.convolution_mode == "same" else [(self.padding, self.padding)]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return y + params["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.act(self.pre_output(params, x, train=train, rng=rng)), state
+
+
+@register
+@dataclass
+class SubsamplingLayer(LayerConf):
+    """Spatial pooling (reference nn/layers/convolution/subsampling/
+    SubsamplingLayer.java): MAX / AVG / SUM / PNORM."""
+    pooling_type: str = "max"
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    expected_input: ClassVar[str] = "cnn"
+
+    def output_type(self, itype):
+        (kh, kw), (sh, sw), (ph, pw) = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding)
+        h = conv_output_size(itype.height, kh, sh, ph, self.convolution_mode)
+        w = conv_output_size(itype.width, kw, sw, pw, self.convolution_mode)
+        return InputTypeConvolutional(h, w, itype.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        (kh, kw), (sh, sw), (ph, pw) = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding)
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, dims, strides, pad)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if pt == "avg":
+                y = y / (kh * kw)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+        return y, state
+
+
+@register
+@dataclass
+class Subsampling1DLayer(LayerConf):
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    expected_input: ClassVar[str] = "rnn"
+
+    def output_type(self, itype):
+        t = itype.timestep_length
+        if t and t > 0:
+            t = conv_output_size(t, self.kernel_size, self.stride, self.padding,
+                                 self.convolution_mode)
+        return InputTypeRecurrent(itype.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        k, s, p = self.kernel_size, self.stride, self.padding
+        dims, strides = (1, k, 1), (1, s, 1)
+        pad = "SAME" if self.convolution_mode == "same" else ((0, 0), (p, p), (0, 0))
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if pt == "avg":
+                y = y / k
+        elif pt == "pnorm":
+            pw = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** pw, 0.0, lax.add, dims, strides, pad) ** (1.0 / pw)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+        return y, state
+
+
+@register
+@dataclass
+class ZeroPaddingLayer(LayerConf):
+    """Spatial zero padding (reference nn/conf/layers/ZeroPaddingLayer.java).
+    padding = (top, bottom, left, right) or (h, w)."""
+    padding: Tuple[int, ...] = (0, 0)
+
+    expected_input: ClassVar[str] = "cnn"
+
+    def _pads(self):
+        p = tuple(int(v) for v in self.padding)
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        return p
+
+    def output_type(self, itype):
+        t, b, l, r = self._pads()
+        return InputTypeConvolutional(itype.height + t + b, itype.width + l + r,
+                                      itype.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t, b, l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register
+@dataclass
+class GlobalPoolingLayer(LayerConf):
+    """Global pooling over spatial (CNN) or time (RNN) dims with mask support
+    (reference nn/layers/pooling/GlobalPoolingLayer.java; masked reductions
+    util/MaskedReductionUtil.java)."""
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    expected_input: ClassVar[str] = "any"
+
+    def output_type(self, itype):
+        from ..inputs import InputTypeFeedForward
+        if isinstance(itype, InputTypeRecurrent):
+            return InputTypeFeedForward(itype.size)
+        if isinstance(itype, InputTypeConvolutional):
+            return InputTypeFeedForward(itype.channels)
+        return itype
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # [B,T,F] -> reduce T ; [B,H,W,C] -> reduce H,W
+        axes = (1,) if x.ndim == 3 else (1, 2)
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[..., None]
+            if pt == "max":
+                x = jnp.where(m > 0, x, -jnp.inf)
+            else:
+                x = x * m
+        if pt == "max":
+            y = jnp.max(x, axis=axes)
+        elif pt == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif pt == "avg":
+            if mask is not None and x.ndim == 3:
+                denom = jnp.clip(jnp.sum(mask.astype(x.dtype), axis=1, keepdims=False), 1.0, None)
+                y = jnp.sum(x, axis=1) / denom[:, None]
+            else:
+                y = jnp.mean(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+        return y, state

@@ -1,0 +1,104 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference parity:
+- BatchNormalization -> nn/conf/layers/BatchNormalization.java +
+  nn/layers/normalization/BatchNormalization.java (helper probe :56; cuDNN
+  impl CudnnBatchNormalizationHelper). On TPU the fused form is what XLA
+  emits natively — no helper seam needed; running stats live in the layer
+  STATE pytree and are updated functionally at train time.
+- LocalResponseNormalization -> nn/layers/normalization/
+  LocalResponseNormalization.java (cross-channel window; k/n/alpha/beta
+  defaults match the reference).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import ClassVar, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.serde import register
+from .base import LayerConf
+
+
+@register
+@dataclass
+class BatchNormalization(LayerConf):
+    n_out: Optional[int] = None        # feature/channel count (inferred)
+    decay: float = 0.9                 # running-stat EMA decay (reference default)
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False      # reference lockGammaBeta: fixed gamma/beta
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    param_order: ClassVar[Tuple[str, ...]] = ("gamma", "beta")
+    weight_param_names: ClassVar[Tuple[str, ...]] = ()   # no l1/l2 on gamma/beta
+    expected_input: ClassVar[str] = "any"
+
+    def _nf(self, itype):
+        if self.n_out:
+            return self.n_out
+        from ..inputs import InputTypeConvolutional
+        if itype is None:
+            raise ValueError(
+                "BatchNormalization cannot infer its feature count: set "
+                "n_out explicitly or provide an input type (set_input_type "
+                "or n_in on the first layer)")
+        if isinstance(itype, InputTypeConvolutional):
+            return itype.channels
+        return itype.size
+
+    def init(self, rng, itype, dtype):
+        nf = self._nf(itype)
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((nf,), self.gamma_init, dtype),
+                      "beta": jnp.full((nf,), self.beta_init, dtype)}
+        state = {"mean": jnp.zeros((nf,), jnp.float32),
+                 "var": jnp.ones((nf,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature dim
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(jnp.float32),
+                "var": self.decay * state["var"] + (1 - self.decay) * var.astype(jnp.float32),
+            }
+        else:
+            mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+            new_state = state
+        inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(self.eps, x.dtype))
+        y = (x - mean.astype(x.dtype)) * inv
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        else:
+            y = y * self.gamma_init + self.beta_init
+        return self.act(y), new_state
+
+
+@register
+@dataclass
+class LocalResponseNormalization(LayerConf):
+    """Cross-channel LRN over NHWC (reference defaults k=2, n=5, alpha=1e-4,
+    beta=0.75)."""
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    expected_input: ClassVar[str] = "cnn"
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        half = self.n // 2
+        sq = x * x
+        # windowed sum over the channel (last) dim
+        summed = lax.reduce_window(sq, 0.0, lax.add,
+                                   (1, 1, 1, self.n), (1, 1, 1, 1),
+                                   ((0, 0), (0, 0), (0, 0), (half, half)))
+        denom = (self.k + self.alpha * summed) ** self.beta
+        return x / denom, state

@@ -31,6 +31,8 @@ def _dtype_of(conf) -> Any:
 
 
 class MultiLayerNetwork:
+    supports_tbptt = True
+
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = tuple(conf.layers)
@@ -68,22 +70,26 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- functional
     def apply_fn(self, params, state, x, *, train: bool = False, rng=None,
-                 to_layer: Optional[int] = None, features_mask=None):
-        """Pure forward pass. Returns (activations_list, new_state).
+                 to_layer: Optional[int] = None, features_mask=None,
+                 rnn_states=None, collect_rnn_states: bool = False):
+        """Pure forward pass. Returns (activations_list, new_state) — or
+        (activations_list, new_state, rnn_states_out) when
+        ``collect_rnn_states`` (used by tBPTT and rnn_time_step).
 
-        activations_list[i] is the OUTPUT of layer i (post-preprocessor input
-        is applied before each layer), mirroring feedForwardToLayer
-        (reference MultiLayerNetwork.java:776-888).
+        activations_list[i] is the OUTPUT of layer i, mirroring
+        feedForwardToLayer (reference MultiLayerNetwork.java:776-888).
+        Per-timestep masks propagate to mask-aware layers (reference MaskState
+        flow, setLayerMaskArrays :1144-1147) and collapse when the time
+        dimension does.
         """
         acts = []
         new_state = []
+        rnn_out = [None] * len(self.layers)
         n = len(self.layers) if to_layer is None else to_layer + 1
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        cur_mask = features_mask
         if features_mask is not None:
-            # Zero padded features/timesteps at the input (reference
-            # setLayerMaskArrays, MultiLayerNetwork.java:1144-1147; full
-            # per-layer MaskState propagation arrives with the recurrent stack).
             m = jnp.asarray(features_mask, x.dtype)
             x = x * m.reshape(m.shape + (1,) * (x.ndim - m.ndim))
         for i in range(len(self.layers)):
@@ -94,26 +100,58 @@ class MultiLayerNetwork:
             if pre is not None:
                 x = pre.apply(x)
             rng, sub = jax.random.split(rng)
-            x, s = self.layers[i].apply(params[i], state[i], x, train=train, rng=sub)
+            layer = self.layers[i]
+            kwargs = {}
+            if getattr(layer, "accepts_mask", False) and cur_mask is not None \
+                    and getattr(cur_mask, "ndim", 0) == 2 and x.ndim == 3:
+                kwargs["mask"] = cur_mask
+            if hasattr(layer, "apply_with_final_state") and \
+                    (collect_rnn_states or (rnn_states is not None
+                                            and rnn_states[i] is not None)):
+                init = rnn_states[i] if rnn_states is not None else None
+                x, final = layer.apply_with_final_state(
+                    params[i], state[i], x, train=train, rng=sub,
+                    initial_state=init, **kwargs)
+                s = state[i]
+                rnn_out[i] = final
+            else:
+                x, s = layer.apply(params[i], state[i], x, train=train, rng=sub,
+                                   **kwargs)
             new_state.append(s)
             acts.append(x)
+            if x.ndim < 3:
+                cur_mask = None   # time dimension collapsed
+        if collect_rnn_states:
+            return acts, tuple(new_state), rnn_out
         return acts, tuple(new_state)
 
     def loss_fn(self, params, state, x, labels, *, train: bool = True, rng=None,
-                labels_mask=None, features_mask=None):
+                labels_mask=None, features_mask=None, rnn_states=None,
+                collect_rnn_states: bool = False):
         """Mean per-example loss + L1/L2 regularization (reference
-        computeGradientAndScore :2121 + BaseLayer.calcL2/calcL1)."""
+        computeGradientAndScore :2121 + BaseLayer.calcL2/calcL1).
+
+        With ``collect_rnn_states`` the aux also carries each recurrent
+        layer's final (h, c) — the tBPTT chunk carry (reference
+        doTruncatedBPTT state sync, MultiLayerNetwork.java:1400)."""
         out_layer = self.layers[-1]
         if not isinstance(out_layer, BaseOutputLayerMixin):
             raise ValueError("Last layer must be an output layer to compute loss")
         if rng is None:
             rng = jax.random.PRNGKey(0)
         rng, fwd_rng = jax.random.split(rng)
+        rnn_out = None
         # forward to second-to-last layer
         if len(self.layers) > 1:
-            acts, new_state = self.apply_fn(params, state, x, train=train, rng=fwd_rng,
-                                            to_layer=len(self.layers) - 2,
-                                            features_mask=features_mask)
+            res = self.apply_fn(params, state, x, train=train, rng=fwd_rng,
+                                to_layer=len(self.layers) - 2,
+                                features_mask=features_mask,
+                                rnn_states=rnn_states,
+                                collect_rnn_states=collect_rnn_states)
+            if collect_rnn_states:
+                acts, new_state, rnn_out = res
+            else:
+                acts, new_state = res
             feed = acts[-1] if acts else x
         else:
             feed = x
@@ -136,6 +174,8 @@ class MultiLayerNetwork:
         reg = 0.0
         for layer, p in zip(self.layers, params):
             reg = reg + layer.regularization(p)
+        if collect_rnn_states:
+            return score + reg, (new_state, rnn_out)
         return score + reg, new_state
 
     # ------------------------------------------------------------- inference
@@ -175,6 +215,30 @@ class MultiLayerNetwork:
         if fm is not None:
             kwargs["fmm"] = jnp.asarray(fm)
         return float(fn(*args, **kwargs))
+
+    # -------------------------------------------------------------- streaming
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference
+        MultiLayerNetwork.rnnTimeStep): feed [B,F] one step (or [B,T,F] a
+        chunk); recurrent state is carried between calls."""
+        x = jnp.asarray(x, _dtype_of(self.conf))
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+
+        def fn(params, state, rnn_states, xx):
+            acts, _, rnn_out = self.apply_fn(params, state, xx, train=False,
+                                             rnn_states=rnn_states,
+                                             collect_rnn_states=True)
+            return acts[-1], rnn_out
+
+        key = ("rnn_time_step", x.shape[1], self._rnn_state is None)
+        jfn = self._jitted(key, fn)
+        out, self._rnn_state = jfn(self.params, self.state, self._rnn_state, x)
+        return out[:, -1] if (single and out.ndim == 3) else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
 
     # ------------------------------------------------------------ flat params
     def params_flat(self) -> jnp.ndarray:

@@ -1,0 +1,192 @@
+"""Transfer learning: clone-and-edit trained networks.
+
+Reference: nn/transferlearning/TransferLearning.java:35-37 (builder: freeze up
+to a boundary via setFeatureExtractor, nOutReplace, removeOutputLayer,
+addLayer, fineTuneConfiguration), FineTuneConfiguration (global hyperparam
+overrides), TransferLearningHelper (featurization: cache frozen-part
+activations and train only the unfrozen head).
+
+Freezing = the layer conf's ``frozen`` flag; the updater skips frozen layers
+(XLA dead-code-eliminates their backward graph, so frozen layers cost nothing
+at train time — the TPU equivalent of the reference's FrozenLayer wrapper).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf.config import MultiLayerConfiguration
+from .multilayer import MultiLayerNetwork
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to every (non-frozen) layer
+    (reference nn/transferlearning/FineTuneConfiguration.java)."""
+    updater: Optional[Any] = None
+    learning_rate: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    activation: Optional[str] = None
+    seed: Optional[int] = None
+
+    def apply_to(self, conf: MultiLayerConfiguration):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        for layer in conf.layers:
+            for f in ("learning_rate", "l1", "l2", "dropout", "activation"):
+                v = getattr(self, f)
+                if v is not None:
+                    setattr(layer, f, v)
+
+
+class TransferLearning:
+    """Builder over a trained MultiLayerNetwork (reference
+    TransferLearning.Builder)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        self._net = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._n_out_replace: Dict[int, tuple] = {}
+        self._remove_from_output: int = 0
+        self._added_layers: List[Any] = []
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferLearning":
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer_idx: int) -> "TransferLearning":
+        """Freeze layers [0..layer_idx] inclusive."""
+        self._freeze_until = layer_idx
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int,
+                      weight_init: str = "xavier") -> "TransferLearning":
+        """Replace layer's output width with fresh weights; the next layer's
+        inputs are re-initialized to match (reference nOutReplace)."""
+        self._n_out_replace[layer_idx] = (n_out, weight_init)
+        return self
+
+    def remove_output_layer(self) -> "TransferLearning":
+        self._remove_from_output = max(self._remove_from_output, 1)
+        return self
+
+    def remove_layers_from_output(self, n: int) -> "TransferLearning":
+        self._remove_from_output = max(self._remove_from_output, n)
+        return self
+
+    def add_layer(self, layer) -> "TransferLearning":
+        self._added_layers.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        src = self._net
+        conf = copy.deepcopy(src.conf)
+        params: List[Dict[str, Any]] = [dict(p) for p in src.params]
+        state: List[Dict[str, Any]] = [dict(s) for s in src.state]
+        keep = len(conf.layers) - self._remove_from_output
+        conf.layers = conf.layers[:keep]
+        params, state = params[:keep], state[:keep]
+        conf.input_preprocessors = {k: v for k, v in conf.input_preprocessors.items()
+                                    if int(k) < keep}
+
+        # nOutReplace: re-init layer and the following layer's fan-in
+        reinit = set()
+        for idx, (n_out, wi) in self._n_out_replace.items():
+            conf.layers[idx] = dataclasses.replace(conf.layers[idx], n_out=n_out,
+                                                   weight_init=wi)
+            reinit.add(idx)
+            if idx + 1 < len(conf.layers) and hasattr(conf.layers[idx + 1], "n_in"):
+                conf.layers[idx + 1] = dataclasses.replace(conf.layers[idx + 1],
+                                                           n_in=n_out)
+                reinit.add(idx + 1)
+
+        # appended layers: infer n_in from the current tail
+        from .layers.base import resolve_ff_size
+        from .inputs import InputTypeFeedForward
+        itype = conf.input_type
+        if itype is None and conf.layers:
+            n_in0 = getattr(conf.layers[0], "n_in", None)
+            if n_in0:
+                itype = InputTypeFeedForward(n_in0)
+        if itype is not None:
+            for i, l in enumerate(conf.layers):
+                pre = conf.preprocessor(i)
+                if pre is not None:
+                    itype = pre.output_type(itype)
+                itype = l.output_type(itype)
+        for layer in self._added_layers:
+            layer = copy.deepcopy(layer)
+            if getattr(layer, "n_in", "absent") is None and itype is not None:
+                layer.n_in = resolve_ff_size(itype)
+            conf.layers.append(layer)
+            reinit.add(len(conf.layers) - 1)
+            if itype is not None:
+                itype = layer.output_type(itype)
+
+        if self._freeze_until is not None:
+            for i in range(min(self._freeze_until + 1, len(conf.layers))):
+                conf.layers[i] = dataclasses.replace(conf.layers[i], frozen=True)
+        if self._fine_tune is not None:
+            self._fine_tune.apply_to(conf)
+
+        new_net = MultiLayerNetwork(conf).init()
+        # carry over surviving parameters; re-initialized layers keep fresh init
+        final_params = list(new_net.params)
+        final_state = list(new_net.state)
+        for i in range(min(len(params), len(conf.layers))):
+            if i not in reinit:
+                final_params[i] = params[i]
+                if i < len(state):
+                    final_state[i] = state[i]
+        new_net.params = tuple(final_params)
+        new_net.state = tuple(final_state)
+        new_net.opt_state = new_net.updater.init(new_net.params)
+        return new_net
+
+
+class TransferLearningHelper:
+    """Featurization: run inputs through the frozen front once, train only the
+    unfrozen tail (reference TransferLearningHelper)."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: Optional[int] = None):
+        self.net = net
+        if frozen_until is None:
+            frozen = [i for i, l in enumerate(net.layers) if getattr(l, "frozen", False)]
+            frozen_until = max(frozen) if frozen else -1
+        self.frozen_until = frozen_until
+        self._featurize_fn = jax.jit(
+            lambda params, state, x: net.apply_fn(params, state, x, train=False,
+                                                  to_layer=self.frozen_until)[0][-1])
+
+    def featurize(self, features):
+        """Map raw inputs to the frozen boundary's activations."""
+        if self.frozen_until < 0:
+            return jnp.asarray(features)
+        return self._featurize_fn(self.net.params, self.net.state,
+                                  jnp.asarray(features))
+
+    def unfrozen_network(self) -> MultiLayerNetwork:
+        """A standalone net of the unfrozen tail sharing parameter values."""
+        conf = copy.deepcopy(self.net.conf)
+        cut = self.frozen_until + 1
+        conf.layers = conf.layers[cut:]
+        conf.input_preprocessors = {str(int(k) - cut): v
+                                    for k, v in conf.input_preprocessors.items()
+                                    if int(k) >= cut}
+        conf.input_type = None
+        tail = MultiLayerNetwork(conf)
+        tail.params = tuple(self.net.params[cut:])
+        tail.state = tuple(self.net.state[cut:])
+        tail.opt_state = tail.updater.init(tail.params)
+        return tail

@@ -55,6 +55,9 @@ def init_weights(rng, shape: Tuple[int, ...], weight_init: str, fan_in: float,
     channels*kernel products, reference ConvolutionParamInitializer).
     """
     wi = str(weight_init).lower()
+    fan_in, fan_out = float(fan_in), float(fan_out)
+    # python-float scalars keep weak typing so the sampled dtype is preserved
+    # (a jnp scalar would be strongly f64 under x64 and promote the result)
     if wi == "zero":
         return jnp.zeros(shape, dtype)
     if wi == "ones":
@@ -64,27 +67,27 @@ def init_weights(rng, shape: Tuple[int, ...], weight_init: str, fan_in: float,
             raise ValueError("WeightInit DISTRIBUTION requires a distribution config")
         return distribution.sample(rng, shape, dtype)
     if wi == "uniform":
-        a = 1.0 / jnp.sqrt(fan_in)
+        a = 1.0 / fan_in ** 0.5
         return jax.random.uniform(rng, shape, dtype, -a, a)
     if wi == "xavier":
-        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        std = (2.0 / (fan_in + fan_out)) ** 0.5
         return std * jax.random.normal(rng, shape, dtype)
     if wi == "xavier_uniform":
-        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        a = (6.0 / (fan_in + fan_out)) ** 0.5
         return jax.random.uniform(rng, shape, dtype, -a, a)
     if wi == "xavier_fan_in":
-        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+        return jax.random.normal(rng, shape, dtype) / fan_in ** 0.5
     if wi == "xavier_legacy":
-        std = 1.0 / jnp.sqrt(fan_in + fan_out)
+        std = 1.0 / (fan_in + fan_out) ** 0.5
         return std * jax.random.normal(rng, shape, dtype)
     if wi == "relu":
-        return jnp.sqrt(2.0 / fan_in) * jax.random.normal(rng, shape, dtype)
+        return (2.0 / fan_in) ** 0.5 * jax.random.normal(rng, shape, dtype)
     if wi == "relu_uniform":
-        a = jnp.sqrt(6.0 / fan_in)
+        a = (6.0 / fan_in) ** 0.5
         return jax.random.uniform(rng, shape, dtype, -a, a)
     if wi == "sigmoid_uniform":
-        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        a = 4.0 * (6.0 / (fan_in + fan_out)) ** 0.5
         return jax.random.uniform(rng, shape, dtype, -a, a)
     if wi == "lecun_normal":
-        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fan_in)
+        return jax.random.normal(rng, shape, dtype) / fan_in ** 0.5
     raise ValueError(f"Unknown weight init {weight_init!r}")

@@ -45,19 +45,74 @@ class Solver:
         self._steps[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._steps[key]
 
+    def _get_tbptt_step(self, has_lmask: bool, has_fmask: bool, chunk_len: int):
+        """Jitted tBPTT chunk step: optimize on one chunk, carry recurrent
+        state (stop-gradient across the chunk boundary — reference
+        doTruncatedBPTT, MultiLayerNetwork.java:1312)."""
+        key = ("tbptt", has_lmask, has_fmask, chunk_len)
+        if key in self._steps:
+            return self._steps[key]
+        net = self.net
+
+        def step(params, state, opt_state, rnn_states, it, rng, x, y,
+                 lmask=None, fmask=None):
+            def lf(p):
+                loss, (new_state, rnn_out) = net.loss_fn(
+                    p, state, x, y, train=True, rng=rng, labels_mask=lmask,
+                    features_mask=fmask, rnn_states=rnn_states,
+                    collect_rnn_states=True)
+                return loss, (new_state, rnn_out)
+            (loss, (new_state, rnn_out)), grads = \
+                jax.value_and_grad(lf, has_aux=True)(params)
+            new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+            rnn_out = jax.lax.stop_gradient(rnn_out)
+            return new_params, new_state, new_opt, rnn_out, loss
+
+        self._steps[key] = jax.jit(step, donate_argnums=(0, 2))
+        return self._steps[key]
+
+    def _fit_tbptt_batch(self, x, y, lmask, fmask, base_rng):
+        net = self.net
+        T = x.shape[1]
+        k = net.conf.tbptt_fwd_length
+        rnn_states = None
+        loss = None
+        for t0 in range(0, T, k):
+            t1 = min(t0 + k, T)
+            xc = x[:, t0:t1]
+            yc = y[:, t0:t1] if y.ndim == 3 else y
+            lc = lmask[:, t0:t1] if (lmask is not None and lmask.ndim == 2) else lmask
+            fc = fmask[:, t0:t1] if (fmask is not None and fmask.ndim == 2) else fmask
+            step_fn = self._get_tbptt_step(lc is not None, fc is not None, t1 - t0)
+            rng = jax.random.fold_in(base_rng, net.iteration_count)
+            kwargs = {}
+            if lc is not None:
+                kwargs["lmask"] = lc
+            if fc is not None:
+                kwargs["fmask"] = fc
+            net.params, net.state, net.opt_state, rnn_states, loss = step_fn(
+                net.params, net.state, net.opt_state, rnn_states,
+                jnp.asarray(net.iteration_count, jnp.int32), rng, xc, yc, **kwargs)
+            net.iteration_count += 1
+        return loss
+
     # ------------------------------------------------------------------- fit
     def fit(self, data=None, labels=None, *, epochs=1, batch_size=None,
             iterator=None, dataset=None, async_prefetch: bool = True):
         net = self.net
         if net.params is None:
             net.init()
-        if net.conf.backprop_type == "tbptt":
+        tbptt = net.conf.backprop_type == "tbptt"
+        if tbptt and not getattr(net, "supports_tbptt", False):
             raise NotImplementedError(
-                "BackpropType tbptt lands with the recurrent stack; "
-                "use backprop_type='standard' for now")
+                "Truncated BPTT is supported on MultiLayerNetwork; "
+                "ComputationGraph tBPTT lands in a later round")
         if iterator is None:
             if dataset is not None:
                 iterator = ListDataSetIterator([dataset])
+            elif _is_multi(data) or _is_multi(labels):
+                # multi-input/multi-output (MultiDataSet-style); no batching split
+                iterator = ListDataSetIterator([DataSet(data, labels)])
             else:
                 features = np.asarray(data)
                 labels = np.asarray(labels)
@@ -74,25 +129,31 @@ class Solver:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_start(net)
             for ds in it_wrapped:
-                x = _cast_features(ds.features, dtype)
-                y = jnp.asarray(ds.labels, dtype)
-                lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask, dtype)
-                fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask, dtype)
-                step_fn = self._get_step(lmask is not None, fmask is not None)
-                rng = jax.random.fold_in(base_rng, net.iteration_count)
-                kwargs = {}
-                if lmask is not None:
-                    kwargs["lmask"] = lmask
-                if fmask is not None:
-                    kwargs["fmask"] = fmask
-                net.params, net.state, net.opt_state, loss = step_fn(
-                    net.params, net.state, net.opt_state,
-                    jnp.asarray(net.iteration_count, jnp.int32), rng, x, y, **kwargs)
+                x = _cast_any(ds.features, dtype)
+                y = _cast_any(ds.labels, dtype)
+                lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)
+                fmask = None if ds.features_mask is None else _cast_any(ds.features_mask, dtype)
+                if tbptt:
+                    loss = self._fit_tbptt_batch(x, y, lmask, fmask, base_rng)
+                else:
+                    step_fn = self._get_step(lmask is not None, fmask is not None)
+                    rng = jax.random.fold_in(base_rng, net.iteration_count)
+                    kwargs = {}
+                    if lmask is not None:
+                        kwargs["lmask"] = lmask
+                    if fmask is not None:
+                        kwargs["fmask"] = fmask
+                    net.params, net.state, net.opt_state, loss = step_fn(
+                        net.params, net.state, net.opt_state,
+                        jnp.asarray(net.iteration_count, jnp.int32), rng, x, y, **kwargs)
+                # listeners get the index of the last executed iteration
+                it_idx = net.iteration_count - 1 if tbptt else net.iteration_count
                 for p in perf:
                     p.note_batch(ds.num_examples())
                 for l in net.listeners:
-                    l.iteration_done(net, net.iteration_count, loss)
-                net.iteration_count += 1
+                    l.iteration_done(net, it_idx, loss)
+                if not tbptt:
+                    net.iteration_count += 1
             for l in net.listeners:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_end(net)
@@ -137,7 +198,7 @@ class Solver:
                 for k in layer_params:
                     upd, new_s[k] = rule.update_one(grads[k], opt_state[k],
                                                     rule.lr(it), it)
-                    new_p[k] = layer_params[k] - upd
+                    new_p[k] = layer_params[k] - upd.astype(layer_params[k].dtype)
                 return new_p, new_s, loss
 
             rule = net.updater.rule_for(layer)
@@ -159,8 +220,22 @@ class Solver:
         return net
 
 
+def _is_multi(x):
+    """True for MultiDataSet-style lists of per-input ARRAYS (a plain nested
+    python list of numbers is single-input data, not multi-input)."""
+    return (isinstance(x, (list, tuple)) and len(x) > 0
+            and isinstance(x[0], (np.ndarray, jnp.ndarray)))
+
+
 def _cast_features(x, dtype):
     x = np.asarray(x)
     if x.dtype.kind in "iu":
         return jnp.asarray(x)
     return jnp.asarray(x, dtype)
+
+
+def _cast_any(x, dtype):
+    """Cast a single array or a list of arrays (MultiDataSet features/labels)."""
+    if isinstance(x, (list, tuple)):
+        return [_cast_features(v, dtype) for v in x]
+    return _cast_features(x, dtype)

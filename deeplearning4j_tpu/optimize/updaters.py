@@ -352,12 +352,20 @@ class MultiLayerUpdater:
         grads = normalize_gradients(grads, self.grad_norm, self.grad_norm_threshold)
         new_params, new_state = [], []
         for conf, g, s, p in zip(self.layer_confs, grads, opt_state, params):
+            if getattr(conf, "frozen", False):
+                # reference FrozenLayer: parameters excluded from updates
+                new_params.append(p)
+                new_state.append(s)
+                continue
             rule = self.rule_for(conf)
             np_, ns_ = {}, {}
             for k in p:
                 lr = rule.lr(step, self._lr_mult(conf, k))
                 upd, ns_[k] = rule.update_one(g[k], s[k], lr, step)
-                np_[k] = p[k] - upd
+                # cast guards against x64 weak-type promotion from traced-int
+                # bias corrections (beta**t) or schedules widening the update
+                np_[k] = p[k] - upd.astype(p[k].dtype)
+                ns_[k] = {sk: sv.astype(s[k][sk].dtype) for sk, sv in ns_[k].items()}
             new_params.append(np_)
             new_state.append(ns_)
         return tuple(new_params), tuple(new_state)

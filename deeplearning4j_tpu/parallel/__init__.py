@@ -1,0 +1,6 @@
+from .mesh import data_sharding, make_mesh, replicated
+from .data_parallel import ParallelWrapper
+from .inference import ParallelInference
+
+__all__ = ["data_sharding", "make_mesh", "replicated", "ParallelWrapper",
+           "ParallelInference"]

@@ -1,0 +1,195 @@
+"""Data-parallel training: the TPU-native ParallelWrapper.
+
+Reference: parallelism/ParallelWrapper.java:54 — thread-per-worker data
+parallelism with parameter averaging every ``averaging_frequency`` iterations
+(:244-250, averageModelsParams :332-361) or SHARED_GRADIENTS mode pushing
+per-iteration updates through a GradientsAccumulator; Spark variants
+(SURVEY.md §2.2) implement the same two semantics across hosts.
+
+TPU mapping (SURVEY.md §5.8):
+- SHARED_GRADIENTS / averaging_frequency=1  ->  per-step synchronous
+  all-reduce: ONE jitted train step over a `Mesh`, batch sharded on the
+  'data' axis, params replicated; XLA/GSPMD inserts the psum over ICI.
+  (This is the reference's gradient-sharing path minus the threshold
+  compression, which ICI bandwidth makes unnecessary; see ops/compression
+  for the DCN variant.)
+- AVERAGING with frequency K>1  ->  faithfully emulated with `shard_map`:
+  each device holds ITS OWN params copy, runs K local steps on its shard
+  stream, then `pmean`s params (and optionally updater state — reference
+  ``averageUpdaters`` flag) across the axis.
+
+Multi-host: the same code runs under `jax.distributed.initialize()`; the mesh
+then spans hosts and the collectives ride ICI/DCN — no Aeron, no parameter
+server (reference SharedTrainingMaster.java:46-53 is replaced wholesale).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..datasets.dataset import AsyncDataSetIterator
+from ..optimize.listeners import PerformanceListener, TrainingListener
+from .mesh import data_sharding, make_mesh, replicated
+
+
+class ParallelWrapper:
+    """API analogue of the reference ParallelWrapper.Builder:
+
+        pw = ParallelWrapper(net, averaging_frequency=3,
+                             training_mode="averaging", average_updaters=True)
+        pw.fit(iterator, epochs=2)
+
+    ``workers`` is accepted for API familiarity but the device count comes
+    from the mesh (every chip is a worker).
+    """
+
+    def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
+                 averaging_frequency: int = 1, training_mode: str = "shared_gradients",
+                 average_updaters: bool = True, prefetch_buffer: int = 2,
+                 report_score_after_averaging: bool = True):
+        self.net = net
+        devices = jax.devices()
+        if workers is not None and mesh is None:
+            devices = devices[:workers]
+            mesh = make_mesh((len(devices),), ("data",), devices)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n = self.mesh.devices.size
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.training_mode = training_mode.lower()
+        self.average_updaters = average_updaters
+        self.prefetch_buffer = prefetch_buffer
+        self._sync_step = None
+        self._avg_steps = {}   # keyed by chunk count (remainder batches differ)
+
+    # ------------------------------------------------------------- sync path
+    def _build_sync_step(self):
+        """Per-step all-reduce DP: jit over the mesh, batch sharded."""
+        net = self.net
+        mesh = self.mesh
+
+        def step(params, state, opt_state, it, rng, x, y):
+            def lf(p):
+                return net.loss_fn(p, state, x, y, train=True, rng=rng)
+            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+            return new_params, new_state, new_opt, loss
+
+        rep = replicated(mesh)
+        dsh = data_sharding(mesh)
+        return jax.jit(
+            step, donate_argnums=(0, 2),
+            in_shardings=(rep, rep, rep, rep, rep, dsh, dsh),
+            out_shardings=(rep, rep, rep, rep))
+
+    # -------------------------------------------------------- averaging path
+    def _build_avg_step(self):
+        """K local steps per device, then pmean of params (+updater state):
+        the reference's averagingFrequency semantics, one XLA program."""
+        net = self.net
+        mesh = self.mesh
+        K = self.averaging_frequency
+        avg_upd = self.average_updaters
+
+        def worker_steps(params, state, opt_state, it, rng, xs, ys):
+            # params/state/opt live per-device (shard_map gives the local copy;
+            # xs/ys: [K, local_batch, ...] — K chunks for K local steps
+            def body(carry, inp):
+                params, state, opt_state, i = carry
+                x, y = inp
+
+                def lf(p):
+                    return net.loss_fn(p, state, x, y, train=True,
+                                       rng=jax.random.fold_in(rng, i))
+                (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                new_params, new_opt = net.updater.update(grads, opt_state, params, it + i)
+                return (new_params, new_state, new_opt, i + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, 0), (xs, ys))
+            # parameter averaging across workers (reference :332-361)
+            params = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), params)
+            state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), state)
+            if avg_upd:
+                opt_state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), opt_state)
+            return params, state, opt_state, jax.lax.pmean(jnp.mean(losses), "data")
+
+        rep_spec = P()
+        dsh_spec = P(None, "data")  # [K, batch, ...] -> shard batch dim
+        fn = shard_map(worker_steps, mesh=mesh,
+                       in_specs=(rep_spec, rep_spec, rep_spec, rep_spec, rep_spec,
+                                 dsh_spec, dsh_spec),
+                       out_specs=(rep_spec, rep_spec, rep_spec, rep_spec),
+                       check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2))
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, iterator, epochs: int = 1):
+        net = self.net
+        if net.params is None:
+            net.init()
+        sync = self.training_mode == "shared_gradients" or self.averaging_frequency == 1
+        if sync and self._sync_step is None:
+            self._sync_step = self._build_sync_step()
+        dtype = jnp.dtype(net.conf.dtype)
+        base_rng = jax.random.PRNGKey(net.conf.seed + 31337)
+        perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
+        it_wrapped = AsyncDataSetIterator(iterator, self.prefetch_buffer)
+
+        for epoch in range(epochs):
+            for l in net.listeners:
+                if isinstance(l, TrainingListener):
+                    l.on_epoch_start(net)
+            if sync:
+                for ds in it_wrapped:
+                    x = jnp.asarray(np.asarray(ds.features), dtype)
+                    y = jnp.asarray(np.asarray(ds.labels), dtype)
+                    rng = jax.random.fold_in(base_rng, net.iteration_count)
+                    net.params, net.state, net.opt_state, loss = self._sync_step(
+                        net.params, net.state, net.opt_state,
+                        jnp.asarray(net.iteration_count, jnp.int32), rng, x, y)
+                    self._notify(perf, ds, loss)
+                    net.iteration_count += 1
+            else:
+                # accumulate K batches then run the fused K-step+average program
+                buf: List[Any] = []
+                for ds in it_wrapped:
+                    buf.append(ds)
+                    if len(buf) == self.averaging_frequency:
+                        self._run_avg(buf, base_rng, dtype, perf)
+                        buf = []
+                if buf:
+                    self._run_avg(buf, base_rng, dtype, perf)
+            for l in net.listeners:
+                if isinstance(l, TrainingListener):
+                    l.on_epoch_end(net)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        return net
+
+    def _run_avg(self, buf, base_rng, dtype, perf):
+        net = self.net
+        xs = jnp.stack([jnp.asarray(np.asarray(d.features), dtype) for d in buf])
+        ys = jnp.stack([jnp.asarray(np.asarray(d.labels), dtype) for d in buf])
+        rng = jax.random.fold_in(base_rng, net.iteration_count)
+        step = self._avg_steps.get(len(buf))
+        if step is None:
+            step = self._avg_steps[len(buf)] = self._build_avg_step()
+        net.params, net.state, net.opt_state, loss = step(
+            net.params, net.state, net.opt_state,
+            jnp.asarray(net.iteration_count, jnp.int32), rng, xs, ys)
+        for d in buf:
+            self._notify(perf, d, loss)
+            net.iteration_count += 1
+
+    def _notify(self, perf, ds, loss):
+        net = self.net
+        for p in perf:
+            p.note_batch(ds.num_examples())
+        for l in net.listeners:
+            l.iteration_done(net, net.iteration_count, loss)

@@ -1,0 +1,98 @@
+"""ParallelInference: multi-request inference serving.
+
+Reference: parallelism/ParallelInference.java:33 — per-device model replicas;
+InferenceMode.BATCHED (default, :53) merges concurrent output() callers into
+one device batch up to batch_limit (BatchedInferenceObservable); SEQUENTIAL
+round-robins.
+
+TPU mapping: one jitted forward over the mesh replaces per-device replicas —
+a merged batch is sharded across the 'data' axis, so batching and
+multi-device dispatch are the same operation.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ParallelInference:
+    def __init__(self, net, *, inference_mode: str = "batched",
+                 batch_limit: int = 32, queue_limit: int = 64,
+                 max_wait_ms: float = 2.0):
+        self.net = net
+        self.mode = inference_mode.lower()
+        self.batch_limit = batch_limit
+        self.max_wait_ms = max_wait_ms
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = False
+        self._worker: Optional[threading.Thread] = None
+        if self.mode == "batched":
+            self._worker = threading.Thread(target=self._dispatch_loop, daemon=True)
+            self._worker.start()
+
+    def output(self, x):
+        x = np.asarray(x)
+        if self.mode != "batched":
+            with self._lock:
+                return np.asarray(self.net.output(x))
+        req = _Request(x)
+        self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _dispatch_loop(self):
+        while not self._shutdown:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch: List[_Request] = [first]
+            total = first.x.shape[0]
+            # scoop up whatever else is queued (up to batch_limit examples)
+            deadline = self.max_wait_ms / 1000.0
+            import time
+            t0 = time.monotonic()
+            while total < self.batch_limit and (time.monotonic() - t0) < deadline:
+                try:
+                    r = self._queue.get_nowait()
+                    batch.append(r)
+                    total += r.x.shape[0]
+                except queue.Empty:
+                    time.sleep(0.0005)
+            try:
+                merged = np.concatenate([r.x for r in batch], axis=0)
+                out = np.asarray(self.net.output(merged))
+                off = 0
+                for r in batch:
+                    n = r.x.shape[0]
+                    r.result = out[off:off + n]
+                    off += n
+            except Exception as e:  # propagate per-request
+                for r in batch:
+                    r.error = e
+            finally:
+                for r in batch:
+                    r.event.set()
+
+    def shutdown(self):
+        self._shutdown = True
+        if self._worker is not None:
+            self._worker.join(timeout=1.0)

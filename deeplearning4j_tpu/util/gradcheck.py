@@ -1,0 +1,106 @@
+"""Numerical-vs-analytic gradient checking.
+
+Reference: gradientcheck/GradientCheckUtil.java:82 (MLN), :246 (CG), :413
+(pretrain) — central-difference per parameter against the analytic gradient,
+double precision enforced (:92-97). This is the correctness backbone of the
+reference's test strategy (SURVEY.md §4) and of ours.
+
+Runs under jax's x64 mode (the caller builds the net with dtype float64 and
+tests enable x64 via conftest); the loss is jitted once over the FLAT
+parameter vector so the 2N forward evaluations are cheap.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flat_loss_fn(net, x, y, labels_mask=None, features_mask=None):
+    """Return loss(flat_params) with the net's structure closed over."""
+    shapes = []
+    for layer, p in zip(net.layers, net.params):
+        for name in layer.param_order:
+            if name in p:
+                shapes.append((name, p[name].shape, p[name].dtype))
+
+    def unflatten(flat):
+        params, off, li = [], 0, 0
+        it = iter(shapes)
+        for layer, p in zip(net.layers, net.params):
+            np_ = dict(p)
+            for name in layer.param_order:
+                if name in p:
+                    _, shape, dtype = next(it)
+                    n = int(np.prod(shape)) if shape else 1
+                    np_[name] = flat[off:off + n].reshape(shape).astype(dtype)
+                    off += n
+            params.append(np_)
+        return tuple(params)
+
+    def loss(flat):
+        params = unflatten(flat)
+        return net.loss_fn(params, net.state, x, y, train=False,
+                           labels_mask=labels_mask, features_mask=features_mask)[0]
+
+    return loss
+
+
+def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 1e-3,
+                    min_abs_error: float = 1e-8, labels_mask=None, features_mask=None,
+                    print_results: bool = False, subset: Optional[int] = None,
+                    seed: int = 0) -> bool:
+    """Central-difference check of d(loss)/d(params) (reference
+    GradientCheckUtil.checkGradients). ``subset`` randomly samples that many
+    parameters instead of checking all (for larger nets).
+
+    Requires the net (and inputs) in float64 — build the conf with
+    dtype="float64" under x64 mode, exactly as the reference forces DOUBLE
+    (GradientCheckUtil.java:92-97).
+    """
+    if jnp.dtype(net.conf.dtype) != jnp.float64:
+        raise ValueError("Gradient checks require dtype='float64' "
+                         "(reference enforces DataBuffer.Type.DOUBLE)")
+    x = jnp.asarray(x, jnp.float64)
+    y = jnp.asarray(y, jnp.float64)
+    if labels_mask is not None:
+        labels_mask = jnp.asarray(labels_mask, jnp.float64)
+    if features_mask is not None:
+        features_mask = jnp.asarray(features_mask, jnp.float64)
+
+    # NOTE: deliberately NOT jitted. XLA fusion algebraically rewrites
+    # compositions like log(sigmoid(x)) with ~1e-9 relative error — harmless
+    # for training, fatal for central differences. Eager op-by-op execution
+    # matches the analytic gradient to full f64 precision.
+    loss = _flat_loss_fn(net, x, y, labels_mask, features_mask)
+    flat0 = jnp.asarray(net.params_flat(), jnp.float64)
+    analytic = np.asarray(jax.grad(_flat_loss_fn(net, x, y, labels_mask,
+                                                 features_mask))(flat0))
+    n = flat0.shape[0]
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.random.default_rng(seed).choice(n, size=subset, replace=False)
+
+    flat0_np = np.asarray(flat0)
+    max_rel_seen, fails = 0.0, 0
+    for i in idxs:
+        pert = flat0_np.copy()
+        pert[i] += epsilon
+        plus = float(loss(jnp.asarray(pert)))
+        pert[i] -= 2 * epsilon
+        minus = float(loss(jnp.asarray(pert)))
+        numeric = (plus - minus) / (2 * epsilon)
+        a = float(analytic[i])
+        denom = abs(a) + abs(numeric)
+        rel = abs(a - numeric) / denom if denom > 0 else 0.0
+        if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+            fails += 1
+            if print_results:
+                print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} rel={rel:.3g}")
+        max_rel_seen = max(max_rel_seen, rel)
+    if print_results:
+        print(f"checked {len(idxs)}/{n} params, max rel error {max_rel_seen:.3g}, "
+              f"{fails} failures")
+    return fails == 0

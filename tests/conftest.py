@@ -6,7 +6,12 @@ xla_force_host_platform_device_count=8 (the analogue of Spark local[n]).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the sandbox presets JAX_PLATFORMS=axon (the real TPU) and
+# its sitecustomize imports jax at interpreter startup, so the env var has
+# already been latched — jax.config.update is the reliable override. Tests
+# must run on the virtual 8-device CPU platform (SURVEY.md §4: the analogue
+# of the reference's Spark local[n] testing).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -14,6 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after env setup)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# x64 for gradient checks (reference forces DOUBLE, GradientCheckUtil.java:92-97).
+# Regular tests pass explicit float32 dtypes, so they are unaffected.
+jax.config.update("jax_enable_x64", True)
 
 
 @pytest.fixture

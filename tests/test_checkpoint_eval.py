@@ -1,0 +1,165 @@
+"""Stage-4 tests: model-zip round trip, ROC/regression metrics, early
+stopping, transfer learning (SURVEY.md §7 stage 4; mirrors reference
+regressiontest/, eval/, earlystopping/, transferlearning tests)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            TransferLearning,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.util.serialization import (restore_model,
+                                                   restore_multilayer_network,
+                                                   write_model)
+
+
+def _toy_net(seed=3, updater=None):
+    conf = (NeuralNetConfiguration(seed=seed, updater=updater or Adam(1e-2))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 4)).astype(np.float32)
+    yi = (x.sum(-1) > 0).astype(int) + (x[:, 0] > 1).astype(int)
+    return x, np.eye(3, dtype=np.float32)[yi]
+
+
+def test_model_zip_round_trip(tmp_path):
+    net = _toy_net()
+    x, y = _toy_data()
+    net.fit(x, y, epochs=3, batch_size=32)
+    path = str(tmp_path / "model.zip")
+    write_model(net, path)
+    restored = restore_multilayer_network(path)
+    assert np.allclose(np.asarray(net.output(x)), np.asarray(restored.output(x)),
+                       atol=1e-6)
+    # updater state restored: continued training matches exactly
+    net.fit(x, y, epochs=1, batch_size=32)
+    restored.fit(x, y, epochs=1, batch_size=32)
+    assert np.allclose(np.asarray(net.params_flat()),
+                       np.asarray(restored.params_flat()), atol=1e-6)
+
+
+def test_restore_model_guesser(tmp_path):
+    net = _toy_net()
+    path = str(tmp_path / "m.zip")
+    write_model(net, path)
+    m = restore_model(path)
+    assert isinstance(m, MultiLayerNetwork)
+    # bare config json restores an (untrained) net
+    jpath = str(tmp_path / "conf.json")
+    with open(jpath, "w") as f:
+        f.write(net.conf.to_json())
+    m2 = restore_model(jpath)
+    assert m2.num_params() == net.num_params()
+
+
+def test_roc_auc():
+    roc = ROC()
+    labels = np.array([0, 0, 1, 1])
+    scores = np.array([0.1, 0.4, 0.35, 0.8])
+    roc.eval(labels, scores)
+    assert roc.calculate_auc() == pytest.approx(0.75)
+    # perfect separation
+    roc2 = ROC()
+    roc2.eval(np.array([0, 0, 1, 1]), np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc2.calculate_auc() == pytest.approx(1.0)
+    assert roc2.calculate_auprc() == pytest.approx(1.0)
+
+
+def test_roc_multiclass():
+    r = ROCMultiClass()
+    labels = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+    preds = np.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8],
+                      [0.6, 0.3, 0.1], [0.3, 0.6, 0.1], [0.2, 0.2, 0.6]])
+    r.eval(labels, preds)
+    assert r.calculate_average_auc() == pytest.approx(1.0)
+
+
+def test_regression_evaluation():
+    re = RegressionEvaluation(["a", "b"])
+    y = np.array([[1.0, 2.0], [2.0, 4.0], [3.0, 6.0]])
+    p = y + np.array([[0.1, -0.2], [-0.1, 0.2], [0.1, -0.2]])
+    re.eval(y, p)
+    assert re.mean_squared_error(0) == pytest.approx(0.01)
+    assert re.mean_absolute_error(1) == pytest.approx(0.2)
+    assert re.correlation_r2(0) > 0.99
+    assert "RMSE" in re.stats()
+
+
+def test_early_stopping_patience():
+    x, y = _toy_data(128)
+    train_it = ListDataSetIterator(features=x, labels=y, batch_size=32)
+    val_it = ListDataSetIterator(features=x, labels=y, batch_size=64)
+    net = _toy_net(updater=Adam(1e-2))
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val_it),
+        model_saver=InMemoryModelSaver(),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(30),
+            ScoreImprovementEpochTerminationCondition(3, 1e-5)],
+        iteration_termination_conditions=[InvalidScoreIterationTerminationCondition()])
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.total_epochs <= 30
+    assert result.best_model is not None
+    assert result.best_model_score <= min(result.score_vs_epoch.values()) + 1e-9
+
+
+def test_transfer_learning_freeze_and_replace():
+    x, y = _toy_data(96)
+    net = _toy_net()
+    net.fit(x, y, epochs=5, batch_size=32)
+    frozen_w_before = np.asarray(net.params[0]["W"])
+
+    new_net = (TransferLearning(net)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.05)))
+               .set_feature_extractor(0)
+               .n_out_replace(1, 3, weight_init="xavier")
+               .build())
+    assert new_net.layers[0].frozen
+    # layer-0 weights carried over
+    assert np.allclose(np.asarray(new_net.params[0]["W"]), frozen_w_before)
+    new_net.fit(x, y, epochs=3, batch_size=32)
+    # frozen layer unchanged by training, head did change
+    assert np.allclose(np.asarray(new_net.params[0]["W"]), frozen_w_before)
+    assert not np.allclose(np.asarray(new_net.params[1]["W"]),
+                           np.asarray(net.params[1]["W"])[:, :3])
+
+
+def test_transfer_learning_helper_featurize():
+    net = _toy_net()
+    new_net = TransferLearning(net).set_feature_extractor(0).build()
+    helper = TransferLearningHelper(new_net)
+    x, _ = _toy_data(16)
+    feats = np.asarray(helper.featurize(x))
+    assert feats.shape == (16, 8)
+    tail = helper.unfrozen_network()
+    out = np.asarray(tail.output(feats))
+    assert np.allclose(out, np.asarray(new_net.output(x)), atol=1e-6)
+
+
+def test_remove_and_add_layers():
+    net = _toy_net()
+    new_net = (TransferLearning(net)
+               .remove_output_layer()
+               .add_layer(DenseLayer(n_out=6, activation="relu"))
+               .add_layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+    assert len(new_net.layers) == 3
+    x, _ = _toy_data(8)
+    assert np.asarray(new_net.output(x)).shape == (8, 2)

@@ -1,0 +1,56 @@
+"""Clustering/NN tests (mirror reference nearestneighbor-core tests:
+VP-tree kNN correctness vs brute force, k-means convergence, t-SNE
+neighborhood preservation)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KMeansClustering, Tsne, VPTree
+
+
+def test_vptree_matches_brute_force():
+    r = np.random.default_rng(0)
+    pts = r.normal(size=(200, 8))
+    tree = VPTree(pts)
+    for qi in [0, 17, 99]:
+        q = pts[qi] + 0.01
+        idx, dist = tree.knn(q, k=5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert set(idx) == set(brute.tolist()), (idx, brute)
+        assert dist == sorted(dist)
+
+
+def test_vptree_cosine():
+    r = np.random.default_rng(1)
+    pts = r.normal(size=(100, 4))
+    tree = VPTree(pts, metric="cosine")
+    idx, _ = tree.knn(pts[3], k=1)
+    assert idx[0] == 3
+
+
+def test_kmeans_separates_blobs():
+    r = np.random.default_rng(2)
+    blobs = np.concatenate([
+        r.normal(loc=(0, 0), scale=0.3, size=(50, 2)),
+        r.normal(loc=(5, 5), scale=0.3, size=(50, 2)),
+        r.normal(loc=(0, 5), scale=0.3, size=(50, 2))])
+    km = KMeansClustering(k=3, seed=4).fit(blobs)
+    labels = km.predict(blobs)
+    # each true blob maps to a single cluster
+    for s in range(3):
+        seg = labels[s * 50:(s + 1) * 50]
+        assert (seg == np.bincount(seg).argmax()).mean() > 0.95
+    # centroids near blob centers
+    cents = np.sort(km.centroids.round(0), axis=0)
+    assert cents.shape == (3, 2)
+
+
+def test_tsne_preserves_clusters():
+    r = np.random.default_rng(3)
+    a = r.normal(loc=0, scale=0.1, size=(30, 10))
+    b = r.normal(loc=3, scale=0.1, size=(30, 10))
+    X = np.concatenate([a, b])
+    Y = Tsne(perplexity=10, n_iter=300, seed=1).fit_transform(X)
+    assert Y.shape == (60, 2)
+    da = np.linalg.norm(Y[:30] - Y[:30].mean(0), axis=1).mean()
+    cross = np.linalg.norm(Y[:30].mean(0) - Y[30:].mean(0))
+    assert cross > 3 * da, (cross, da)  # clusters separate in the embedding

@@ -1,0 +1,132 @@
+"""ComputationGraph tests (mirror reference TestComputationGraphNetwork,
+GradientCheckTestsComputationGraph, zoo model build+step tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (ElementWiseVertex,
+                                                  L2NormalizeVertex,
+                                                  MergeVertex, SubsetVertex)
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+R = np.random.default_rng(7)
+
+
+def _simple_graph(updater=None, dtype="float32"):
+    g = (NeuralNetConfiguration(seed=5, updater=updater or Adam(5e-3), dtype=dtype)
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_out=16, activation="tanh"), "in")
+         .add_layer("d2", DenseLayer(n_out=16, activation="relu"), "in")
+         .add_vertex("merge", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                    "merge")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)))
+    return ComputationGraph(g.build()).init()
+
+
+def test_graph_forward_shapes_and_fit():
+    net = _simple_graph()
+    x = R.normal(size=(32, 4)).astype(np.float32)
+    yi = (x.sum(-1) > 0).astype(int) + (x[:, 0] > 1).astype(int)
+    y = np.eye(3, dtype=np.float32)[yi]
+    out = np.asarray(net.output(x))
+    assert out.shape == (32, 3)
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=30, batch_size=32)
+    assert net.score(x, y) < s0
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.8
+
+
+def test_graph_json_round_trip():
+    net = _simple_graph()
+    js = net.conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(js)
+    net2 = ComputationGraph(conf2).init()
+    assert net2.num_params() == net.num_params()
+    net2.set_params_flat(net.params_flat())
+    x = R.normal(size=(5, 4)).astype(np.float32)
+    assert np.allclose(np.asarray(net.output(x)), np.asarray(net2.output(x)),
+                       atol=1e-6)
+
+
+def test_multi_input_multi_output():
+    g = (NeuralNetConfiguration(seed=3, updater=Sgd(0.1))
+         .graph_builder()
+         .add_inputs("inA", "inB")
+         .add_layer("dA", DenseLayer(n_out=8, activation="tanh"), "inA")
+         .add_layer("dB", DenseLayer(n_out=8, activation="tanh"), "inB")
+         .add_vertex("sum", ElementWiseVertex(op="add"), "dA", "dB")
+         .add_layer("out1", OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                    "sum")
+         .add_layer("out2", OutputLayer(n_out=1, activation="identity", loss="mse"),
+                    "sum")
+         .set_outputs("out1", "out2")
+         .set_input_types(InputType.feed_forward(4), InputType.feed_forward(6)))
+    net = ComputationGraph(g.build()).init()
+    xa = R.normal(size=(16, 4)).astype(np.float32)
+    xb = R.normal(size=(16, 6)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[R.integers(0, 2, 16)]
+    y2 = R.normal(size=(16, 1)).astype(np.float32)
+    o1, o2 = net.output(xa, xb)
+    assert np.asarray(o1).shape == (16, 2)
+    assert np.asarray(o2).shape == (16, 1)
+    s0 = net.score([xa, xb], [y1, y2])
+    net.fit([xa, xb], [y1, y2], epochs=20)
+    assert net.score([xa, xb], [y1, y2]) < s0
+
+
+def test_vertices_subset_l2norm():
+    g = (NeuralNetConfiguration(seed=3, updater=Sgd(0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .add_vertex("subset", SubsetVertex(from_idx=1, to_idx=2), "in")
+         .add_vertex("l2n", L2NormalizeVertex(), "subset")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                    "l2n")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(g.build()).init()
+    x = R.normal(size=(8, 4)).astype(np.float32)
+    acts = net.feed_forward(x)
+    assert np.asarray(acts["subset"]).shape == (8, 2)
+    norms = np.linalg.norm(np.asarray(acts["l2n"]), axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-4)
+
+
+def test_graph_gradient_check():
+    from deeplearning4j_tpu.util.gradcheck import check_gradients
+    net = _simple_graph(updater=Sgd(0.1), dtype="float64")
+    x = R.normal(size=(6, 4))
+    y = np.eye(3)[R.integers(0, 3, 6)]
+    assert check_gradients(net, x, y, print_results=True)
+
+
+@pytest.mark.slow
+def test_resnet50_builds_and_steps():
+    from deeplearning4j_tpu.models.zoo import resnet50
+    net = resnet50(n_classes=10, height=32, width=32, channels=3).init()
+    assert net.num_params() > 23_000_000
+    x = R.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[R.integers(0, 10, 2)]
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=2)
+    assert np.isfinite(net.score(x, y))
+
+
+def test_simple_cnn_and_vgg_build():
+    from deeplearning4j_tpu.models.zoo import simple_cnn, vgg16
+    net = simple_cnn(n_classes=5, height=16, width=16, channels=3).init()
+    x = R.normal(size=(2, 16, 16, 3)).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 5)
+    v = vgg16(n_classes=10, height=32, width=32, channels=3).init()
+    assert np.asarray(v.output(x.repeat(2, axis=1).repeat(2, axis=2))).shape == (2, 10)

@@ -1,0 +1,103 @@
+"""NLP tests (mirror reference deeplearning4j-nlp tests: Word2Vec end-to-end
+on a synthetic corpus with similarity assertions, serde round-trips,
+tokenizers, vocab/Huffman)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CommonPreprocessor,
+                                    DefaultTokenizerFactory, Glove,
+                                    NGramTokenizerFactory, ParagraphVectors,
+                                    VocabCache, Word2Vec, read_word_vectors,
+                                    read_binary_word_vectors,
+                                    write_binary_word_vectors,
+                                    write_word_vectors)
+
+
+def _corpus(n=300, seed=0):
+    """Synthetic corpus with clear topical structure: 'day/sun/light' vs
+    'night/moon/dark' (stands in for the reference's raw_sentences.txt
+    sim('day','night') assertions)."""
+    r = np.random.default_rng(seed)
+    day_words = ["day", "sun", "light", "morning", "bright"]
+    night_words = ["night", "moon", "dark", "evening", "stars"]
+    other = ["the", "a", "is", "was", "and"]
+    out = []
+    for _ in range(n):
+        topic = day_words if r.random() < 0.5 else night_words
+        sent = []
+        for _ in range(r.integers(5, 12)):
+            sent.append(topic[r.integers(len(topic))] if r.random() < 0.7
+                        else other[r.integers(len(other))])
+        out.append(" ".join(sent))
+    return out
+
+
+def test_tokenizers():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo").get_tokens()
+    assert toks == ["hello", "world", "foo"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_vocab_and_huffman():
+    vc = VocabCache.build([["a", "a", "a", "b", "b", "c"]])
+    assert vc.index_of("a") == 0  # most frequent first
+    assert vc.word_frequency("b") == 2
+    vc.build_huffman()
+    codes = {w: vc.word_for(w).code for w in ("a", "b", "c")}
+    assert len(codes["a"]) <= len(codes["c"])  # frequent => shorter code
+    # prefix-free
+    for w1, c1 in codes.items():
+        for w2, c2 in codes.items():
+            if w1 != w2:
+                assert c1 != c2[:len(c1)] or len(c1) > len(c2)
+
+
+def test_word2vec_similarity_structure():
+    w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=2, epochs=10,
+                   negative=5, learning_rate=0.05, seed=3)
+    w2v.fit(_corpus())
+    assert w2v.has_word("day") and w2v.has_word("night")
+    same_topic = w2v.similarity("day", "sun")
+    cross_topic = w2v.similarity("day", "moon")
+    assert same_topic > cross_topic, (same_topic, cross_topic)
+    nearest = w2v.words_nearest("sun", 4)
+    assert any(w in ("day", "light", "morning", "bright") for w in nearest), nearest
+
+
+def test_word_vector_serde_round_trip(tmp_path):
+    w2v = Word2Vec(layer_size=16, min_word_frequency=1, epochs=2, seed=1)
+    w2v.fit(["one two three", "one two", "three four one"])
+    txt = str(tmp_path / "vecs.txt")
+    write_word_vectors(w2v, txt)
+    loaded = read_word_vectors(txt)
+    assert np.allclose(loaded.get_word_vector("one"),
+                       w2v.get_word_vector("one"), atol=1e-5)
+    binp = str(tmp_path / "vecs.bin")
+    write_binary_word_vectors(w2v, binp)
+    loaded_b = read_binary_word_vectors(binp)
+    assert np.allclose(loaded_b.get_word_vector("three"),
+                       w2v.get_word_vector("three"), atol=1e-6)
+
+
+def test_paragraph_vectors():
+    docs = [("doc_day", " ".join(["sun day light bright"] * 5)),
+            ("doc_night", " ".join(["moon night dark stars"] * 5))]
+    pv = ParagraphVectors(layer_size=24, min_word_frequency=1, epochs=15,
+                          negative=4, learning_rate=0.05, seed=2)
+    pv.fit(docs)
+    assert pv.get_doc_vector("doc_day") is not None
+    v = pv.infer_vector("sun light day")
+    assert v.shape == (24,)
+    sim_day = pv.similarity_to_label("sun light bright day", "doc_day")
+    sim_night = pv.similarity_to_label("sun light bright day", "doc_night")
+    assert sim_day > sim_night, (sim_day, sim_night)
+
+
+def test_glove_trains():
+    g = Glove(layer_size=16, window=4, min_word_frequency=2, epochs=20,
+              seed=5, batch_size=4096)
+    g.fit([s.split() for s in _corpus(200)])
+    assert g.similarity("day", "sun") > g.similarity("day", "moon")

@@ -1,0 +1,116 @@
+"""Recurrent tests: LSTM gradient checks incl. masking (mirror reference
+LSTMGradientCheckTests, GradientCheckTestsMasking), rnn_time_step streaming
+consistency, tBPTT training (SURVEY.md §7 stage 6)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (DenseLayer, GlobalPoolingLayer,
+                                          GravesBidirectionalLSTM, GravesLSTM,
+                                          LSTM, LastTimeStepLayer, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.util.gradcheck import check_gradients
+
+R = np.random.default_rng(99)
+
+
+def _rnn_net(layers, dtype="float32", updater=None, tbptt=None, seed=12345):
+    b = NeuralNetConfiguration(seed=seed, updater=updater or Sgd(0.1),
+                               dtype=dtype).list(*layers)
+    b = b.set_input_type(InputType.recurrent(3, 8))
+    if tbptt:
+        b = b.tbptt_length(tbptt)
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _seq_data(n=4, t=8, f=3, c=2, seed=1):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, t, f))
+    yi = (x.sum((1, 2)) > 0).astype(int)
+    y_seq = np.eye(c)[np.tile(yi[:, None], (1, t))]
+    y_last = np.eye(c)[yi]
+    return x, y_seq, y_last
+
+
+@pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM])
+def test_lstm_gradient_checks(layer_cls):
+    net = _rnn_net([layer_cls(n_out=4, activation="tanh"),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   dtype="float64")
+    x, y_seq, _ = _seq_data()
+    assert check_gradients(net, x, y_seq, print_results=True)
+
+
+def test_lstm_masking_gradient_check():
+    net = _rnn_net([GravesLSTM(n_out=4, activation="tanh"),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   dtype="float64")
+    x, y_seq, _ = _seq_data()
+    mask = np.ones((4, 8))
+    mask[1, 5:] = 0.0
+    mask[3, 2:] = 0.0
+    assert check_gradients(net, x, y_seq, labels_mask=mask, features_mask=mask,
+                           print_results=True)
+
+
+def test_lstm_global_pooling_gradient_check():
+    net = _rnn_net([LSTM(n_out=4, activation="tanh"),
+                    GlobalPoolingLayer(pooling_type="avg"),
+                    OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   dtype="float64")
+    x, _, y_last = _seq_data()
+    assert check_gradients(net, x, y_last, print_results=True)
+
+
+def test_rnn_time_step_matches_full_sequence():
+    net = _rnn_net([GravesLSTM(n_out=5, activation="tanh"),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")])
+    x, _, _ = _seq_data(n=2, t=8)
+    x = x.astype(np.float32)
+    full = np.asarray(net.output(x))                   # [B,T,C]
+    net.rnn_clear_previous_state()
+    step_outs = []
+    for t in range(8):
+        step_outs.append(np.asarray(net.rnn_time_step(x[:, t])))
+    stepped = np.stack(step_outs, axis=1)
+    assert np.allclose(full, stepped, atol=1e-5), np.abs(full - stepped).max()
+    # clearing state restarts the stream
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, 0]))
+    assert np.allclose(again, step_outs[0], atol=1e-6)
+
+
+def test_tbptt_training_runs_and_learns():
+    x, y_seq, _ = _seq_data(n=16, t=8, seed=3)
+    x = x.astype(np.float32)
+    y_seq = y_seq.astype(np.float32)
+    net = _rnn_net([GravesLSTM(n_out=8, activation="tanh"),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   updater=Adam(1e-2), tbptt=4)
+    assert net.conf.backprop_type == "tbptt"
+    s0 = net.score(x, y_seq)
+    net.fit(x, y_seq, epochs=20, batch_size=16)
+    assert net.score(x, y_seq) < s0
+    assert net.iteration_count == 40  # 2 chunks per batch * 20 epochs
+
+
+def test_last_time_step_layer():
+    net = _rnn_net([LSTM(n_out=4, activation="tanh"),
+                    LastTimeStepLayer(),
+                    OutputLayer(n_out=2, activation="softmax", loss="mcxent")])
+    x, _, y_last = _seq_data()
+    out = np.asarray(net.output(x.astype(np.float32)))
+    assert out.shape == (4, 2)
+    net.fit(x.astype(np.float32), y_last.astype(np.float32), epochs=2)
+
+
+def test_dense_is_time_distributed():
+    """Dense on [B,T,F] applies per timestep (equivalent to the reference's
+    RnnToFeedForward sandwich)."""
+    net = _rnn_net([DenseLayer(n_out=6, activation="tanh"),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")])
+    x, _, _ = _seq_data()
+    out = np.asarray(net.output(x.astype(np.float32)))
+    assert out.shape == (4, 8, 2)

@@ -6,8 +6,10 @@ double precision enforced (:92-97). This is the correctness backbone of the
 reference's test strategy (SURVEY.md §4) and of ours.
 
 Runs under jax's x64 mode (the caller builds the net with dtype float64 and
-tests enable x64 via conftest); the loss is jitted once over the FLAT
-parameter vector so the 2N forward evaluations are cheap.
+tests enable x64 via conftest). The loss is deliberately NEVER jitted (see
+the note in ``check_gradients``); instead the 2N forward evaluations are
+vectorized with eager ``jax.vmap`` in chunks, which batches every primitive
+without giving XLA a chance to algebraically rewrite the composition.
 """
 from __future__ import annotations
 
@@ -48,6 +50,30 @@ def _flat_loss_fn(net, x, y, labels_mask=None, features_mask=None):
     return loss
 
 
+def _perturbed_losses(loss, flat0: np.ndarray, idxs: np.ndarray,
+                      epsilon: float) -> np.ndarray:
+    """Evaluate ``loss`` at flat0 ± epsilon·e_i for each i in ``idxs``,
+    returning the [2K] values (first K rows +eps, last K rows -eps).
+
+    Eager (un-jitted) ``vmap`` in chunks: every primitive executes op-by-op
+    exactly as in the scalar path (so the f64 numerics are identical — no XLA
+    fusion rewrites), but dispatch overhead is amortized over the chunk.
+    Perturbation rows are built per-chunk so peak memory stays O(chunk·n),
+    never O(K·n).
+    """
+    k, n = len(idxs), flat0.shape[0]
+    chunk = max(1, min(512, (1 << 22) // max(n, 1)))
+    batched = jax.vmap(loss)
+    signs = np.concatenate([np.full(k, epsilon), np.full(k, -epsilon)])
+    cols = np.concatenate([idxs, idxs])
+    out = np.empty((2 * k,), np.float64)
+    for s in range(0, 2 * k, chunk):
+        rows = np.broadcast_to(flat0, (len(cols[s:s + chunk]), n)).copy()
+        rows[np.arange(rows.shape[0]), cols[s:s + chunk]] += signs[s:s + chunk]
+        out[s:s + chunk] = np.asarray(batched(jnp.asarray(rows)))
+    return out
+
+
 def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 1e-3,
                     min_abs_error: float = 1e-8, labels_mask=None, features_mask=None,
                     print_results: bool = False, subset: Optional[int] = None,
@@ -73,7 +99,8 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 
     # NOTE: deliberately NOT jitted. XLA fusion algebraically rewrites
     # compositions like log(sigmoid(x)) with ~1e-9 relative error — harmless
     # for training, fatal for central differences. Eager op-by-op execution
-    # matches the analytic gradient to full f64 precision.
+    # (vmap-batched, which does not fuse) matches the analytic gradient to
+    # full f64 precision.
     loss = _flat_loss_fn(net, x, y, labels_mask, features_mask)
     flat0 = jnp.asarray(net.params_flat(), jnp.float64)
     analytic = np.asarray(jax.grad(_flat_loss_fn(net, x, y, labels_mask,
@@ -84,14 +111,13 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 
         idxs = np.random.default_rng(seed).choice(n, size=subset, replace=False)
 
     flat0_np = np.asarray(flat0)
+    k = len(idxs)
+    vals = _perturbed_losses(loss, flat0_np, np.asarray(idxs), epsilon)
+    numeric_all = (vals[:k] - vals[k:]) / (2 * epsilon)
+
     max_rel_seen, fails = 0.0, 0
-    for i in idxs:
-        pert = flat0_np.copy()
-        pert[i] += epsilon
-        plus = float(loss(jnp.asarray(pert)))
-        pert[i] -= 2 * epsilon
-        minus = float(loss(jnp.asarray(pert)))
-        numeric = (plus - minus) / (2 * epsilon)
+    for j, i in enumerate(idxs):
+        numeric = float(numeric_all[j])
         a = float(analytic[i])
         denom = abs(a) + abs(numeric)
         rel = abs(a - numeric) / denom if denom > 0 else 0.0

@@ -54,13 +54,44 @@ def _keras_act(cfg, default="identity"):
     return _ACT_MAP[a]
 
 
+def _normalize_loss_entry(loss):
+    """One training-config loss entry -> canonical keras snake_case name.
+    Handles plain strings and serialized loss OBJECTS ({'class_name': ...,
+    'config': {'name': 'mean_squared_error', ...}}) that keras writes when
+    the model was compiled with e.g. keras.losses.MeanSquaredError()."""
+    if loss is None or isinstance(loss, str):
+        return loss
+    if isinstance(loss, dict) and "class_name" in loss:
+        name = (loss.get("config") or {}).get("name")
+        if name:
+            return name
+        import re
+        return re.sub(r"(?<!^)(?=[A-Z])", "_", loss["class_name"]).lower()
+    return loss
+
+
+def _keras_loss(loss: Optional[str], enforce: bool = False) -> str:
+    """Map a Keras loss name; unknown -> mcxent fallback (raise when
+    enforce_training_config, reference KerasModel enforceTrainingConfig)."""
+    loss = _normalize_loss_entry(loss)
+    if loss is None:
+        return "mcxent"
+    if isinstance(loss, str) and loss in _LOSS_MAP:
+        return _LOSS_MAP[loss]
+    if enforce:
+        raise ValueError(f"Unsupported Keras loss {loss!r} "
+                         f"(enforce_training_config=True)")
+    return "mcxent"
+
+
 class KerasLayerTranslator:
     """Translate one Keras layer config dict -> our layer conf (or None for
     structural layers like Flatten/InputLayer, which our InputType system
     absorbs)."""
 
-    def __init__(self, dim_ordering: str = "tf"):
+    def __init__(self, dim_ordering: str = "tf", enforce: bool = False):
         self.dim_ordering = dim_ordering
+        self.enforce = enforce
 
     def translate(self, klass: str, cfg: Dict[str, Any], is_output: bool,
                   loss: Optional[str]):
@@ -71,7 +102,7 @@ class KerasLayerTranslator:
             act = _keras_act(cfg)
             if is_output:
                 return OutputLayer(n_out=int(n_out), activation=act,
-                                   loss=_LOSS_MAP.get(loss or "", "mcxent"))
+                                   loss=_keras_loss(loss, self.enforce))
             return DenseLayer(n_out=int(n_out), activation=act)
         if klass in ("Convolution2D", "Conv2D"):
             n_out = cfg.get("nb_filter") or cfg.get("filters")
@@ -99,6 +130,14 @@ class KerasLayerTranslator:
             p = cfg.get("p") or cfg.get("rate") or 0.5
             return DropoutLayer(dropout=1.0 - float(p))  # keras p = drop prob
         if klass == "Activation":
+            if is_output:
+                # final standalone Activation (e.g. Dense(linear) + Activation
+                # ('softmax')) becomes the scoring layer, so multi-layer heads
+                # import as a proper output layer instead of mis-assigning the
+                # loss to the preceding Dense.
+                from ..nn.layers import LossLayer
+                return LossLayer(activation=_keras_act(cfg),
+                                 loss=_keras_loss(loss, self.enforce))
             return ActivationLayer(activation=_keras_act(cfg))
         if klass == "BatchNormalization":
             return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
@@ -185,28 +224,19 @@ def import_keras_sequential_model_and_weights(path: str, *, enforce_training_con
     """Reference KerasModelImport.importKerasSequentialModelAndWeights."""
     import h5py
     with h5py.File(path, "r") as f:
-        raw = f.attrs.get("model_config")
-        if raw is None:
-            raise ValueError(f"{path} has no model_config attribute")
-        model_cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
-        training_cfg = f.attrs.get("training_config")
-        loss = None
-        if training_cfg is not None:
-            tc = json.loads(training_cfg if isinstance(training_cfg, str)
-                            else training_cfg.decode())
-            loss = tc.get("loss")
+        model_cfg, loss = _read_model_config(f, path)
+        if isinstance(loss, dict) and "class_name" not in loss:
+            loss = next(iter(loss.values()), None)   # single-output: any entry
+        elif isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
         if model_cfg.get("class_name") != "Sequential":
             raise ValueError("Use import_keras_model_and_weights for functional models")
         layer_cfgs = model_cfg["config"]
         if isinstance(layer_cfgs, dict):
             layer_cfgs = layer_cfgs["layers"]
 
-        dim_ordering = "tf"
-        for lc in layer_cfgs:
-            if "dim_ordering" in lc.get("config", {}):
-                dim_ordering = lc["config"]["dim_ordering"]
-                break
-        tr = KerasLayerTranslator(dim_ordering)
+        dim_ordering = _detect_dim_ordering(layer_cfgs)
+        tr = KerasLayerTranslator(dim_ordering, enforce=enforce_training_config)
         confs, keras_names, keras_classes = [], [], []
         itype = None
         for i, lc in enumerate(layer_cfgs):
@@ -232,39 +262,44 @@ def import_keras_sequential_model_and_weights(path: str, *, enforce_training_con
     return net
 
 
+def _assign_layer_arrays(layer, arrays, pdict, sdict, dim_ordering):
+    """Write one Keras layer's weight arrays into a (params, state) dict pair
+    (reference KerasModel.java:510-523 copyWeightsToModel). Shared by the
+    Sequential (MLN) and functional (ComputationGraph) import paths."""
+    from ..nn.layers import (BatchNormalization, ConvolutionLayer,
+                             DenseLayer, EmbeddingLayer, LSTM)
+    if isinstance(layer, ConvolutionLayer):
+        W = arrays[0]
+        if W.ndim == 4 and dim_ordering == "th":
+            W = W.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        pdict["W"] = np_cast(W, pdict["W"])
+        if len(arrays) > 1:
+            pdict["b"] = np_cast(arrays[1], pdict["b"])
+    elif isinstance(layer, LSTM):
+        conv = _convert_lstm_weights(arrays, layer.n_out)
+        for k, v in conv.items():
+            pdict[k] = np_cast(v, pdict[k])
+    elif isinstance(layer, BatchNormalization):
+        # keras order: gamma, beta, running_mean, running_var
+        pdict["gamma"] = np_cast(arrays[0], pdict["gamma"])
+        pdict["beta"] = np_cast(arrays[1], pdict["beta"])
+        if len(arrays) >= 4:
+            sdict["mean"] = np_cast(arrays[2], sdict["mean"])
+            sdict["var"] = np_cast(arrays[3], sdict["var"])
+    elif isinstance(layer, (DenseLayer, EmbeddingLayer)):
+        pdict["W"] = np_cast(arrays[0], pdict["W"])
+        if len(arrays) > 1 and "b" in pdict:
+            pdict["b"] = np_cast(arrays[1], pdict["b"])
+
+
 def _copy_weights_mln(net, keras_names, keras_classes, weights, dim_ordering):
     params = [dict(p) for p in net.params]
     state = [dict(s) for s in net.state]
     for li, (kname, kclass) in enumerate(zip(keras_names, keras_classes)):
         if kname not in weights:
             continue
-        arrays = weights[kname]
-        layer = net.layers[li]
-        from ..nn.layers import (BatchNormalization, ConvolutionLayer,
-                                 DenseLayer, EmbeddingLayer, LSTM, OutputLayer)
-        if isinstance(layer, (ConvolutionLayer,)):
-            W = arrays[0]
-            if W.ndim == 4 and dim_ordering == "th":
-                W = W.transpose(2, 3, 1, 0)  # OIHW -> HWIO
-            params[li]["W"] = np_cast(W, params[li]["W"])
-            if len(arrays) > 1:
-                params[li]["b"] = np_cast(arrays[1], params[li]["b"])
-        elif isinstance(layer, LSTM):
-            conv = _convert_lstm_weights(arrays, layer.n_out)
-            for k, v in conv.items():
-                params[li][k] = np_cast(v, params[li][k])
-        elif isinstance(layer, BatchNormalization):
-            # keras order: gamma, beta, running_mean, running_var
-            params[li]["gamma"] = np_cast(arrays[0], params[li]["gamma"])
-            params[li]["beta"] = np_cast(arrays[1], params[li]["beta"])
-            if len(arrays) >= 4:
-                state[li]["mean"] = np_cast(arrays[2], state[li]["mean"])
-                state[li]["var"] = np_cast(arrays[3], state[li]["var"])
-        elif isinstance(layer, (DenseLayer, OutputLayer, EmbeddingLayer)):
-            params[li]["W"] = np_cast(arrays[0], params[li]["W"])
-            if len(arrays) > 1 and "b" in params[li]:
-                params[li]["b"] = np_cast(arrays[1], params[li]["b"])
-    import jax.numpy as jnp
+        _assign_layer_arrays(net.layers[li], weights[kname], params[li],
+                             state[li], dim_ordering)
     net.params = tuple(params)
     net.state = tuple(state)
     net.opt_state = net.updater.init(net.params)
@@ -279,7 +314,211 @@ def np_cast(src, like):
     return jnp.asarray(src, like.dtype)
 
 
-def import_keras_model(path: str):
+# --------------------------------------------------------------- functional
+def _inbound_names(node) -> List[str]:
+    """Extract input layer names from one inbound node, covering both the
+    legacy Keras-1/2 format ([["name", node_idx, tensor_idx, {...}], ...])
+    and the Keras-3 format ({"args": [{"class_name": "__keras_tensor__",
+    "config": {"keras_history": ["name", 0, 0]}}, ...], "kwargs": ...})."""
+    names: List[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if o.get("class_name") == "__keras_tensor__":
+                names.append(o["config"]["keras_history"][0])
+            elif "args" in o:
+                walk(o["args"])
+        elif isinstance(o, (list, tuple)):
+            if (len(o) >= 3 and isinstance(o[0], str)
+                    and isinstance(o[1], int) and isinstance(o[2], int)):
+                names.append(o[0])
+            else:
+                for v in o:
+                    walk(v)
+
+    walk(node)
+    return names
+
+
+def _io_layer_names(entry) -> List[str]:
+    """config['input_layers'] / ['output_layers']: either [name, 0, 0] for a
+    single tensor or [[name, 0, 0], ...] for several."""
+    if not entry:
+        return []
+    if isinstance(entry[0], str):
+        return [entry[0]]
+    return [e[0] for e in entry]
+
+
+def _loss_for_output(loss, out_name: str, out_index: int):
+    """Keras training_config loss may be a single loss (str or serialized
+    object — applies to all outputs), a dict keyed by output layer name, or a
+    positional list."""
+    if loss is None or isinstance(loss, str):
+        return loss
+    if isinstance(loss, dict):
+        if "class_name" in loss:  # one serialized loss object for all outputs
+            return loss
+        return loss.get(out_name)
+    if isinstance(loss, (list, tuple)) and out_index < len(loss):
+        return loss[out_index]
+    return None
+
+
+def _detect_dim_ordering(layer_cfgs) -> str:
+    """'tf' (channels-last) unless a Keras-1 'dim_ordering' key says 'th'.
+    Keras-1 'th' files store conv kernels OIHW (transposed at weight copy);
+    Keras>=2 'channels_first' models store kernels HWIO regardless, but their
+    whole dataflow is NCHW — unsupported against our NHWC runtime, so gate
+    clearly instead of importing garbage."""
+    for lc in layer_cfgs:
+        c = lc.get("config", {})
+        if c.get("data_format") == "channels_first":
+            raise ValueError(
+                "channels_first Keras models are not supported; rebuild the "
+                "model with data_format='channels_last' (runtime layout is "
+                "NHWC)")
+        if "dim_ordering" in c:
+            return c["dim_ordering"]
+    return "tf"
+
+
+def _read_model_config(f, path):
+    raw = f.attrs.get("model_config")
+    if raw is None:
+        raise ValueError(f"{path} has no model_config attribute")
+    model_cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
+    training_cfg = f.attrs.get("training_config")
+    loss = None
+    if training_cfg is not None:
+        tc = json.loads(training_cfg if isinstance(training_cfg, str)
+                        else training_cfg.decode())
+        loss = tc.get("loss")
+    return model_cfg, loss
+
+
+def import_keras_model_and_weights(path: str, *, enforce_training_config=False):
+    """Functional Keras Model -> ComputationGraph with weights copied
+    (reference KerasModel.java:418 getComputationGraphConfiguration +
+    :510-523 getComputationGraph/copyWeightsToModel). Layers become
+    LayerVertex entries in the Keras topological order; merge layers become
+    Merge/ElementWise vertices; structural layers (InputLayer/Flatten/
+    Reshape) are dissolved, their consumers rewired to the producer — our
+    InputType machinery auto-inserts the CNN->FF preprocessor the Flatten
+    stood for."""
+    import h5py
+    from ..nn.conf.config import NeuralNetConfiguration
+    from ..nn.graph.graph import ComputationGraph
+    from ..nn.graph.vertices import (ElementWiseVertex, LastTimeStepVertex,
+                                     MergeVertex)
+
+    with h5py.File(path, "r") as f:
+        model_cfg, loss = _read_model_config(f, path)
+        if model_cfg.get("class_name") not in ("Model", "Functional"):
+            raise ValueError(f"{path} is not a functional Keras model "
+                             f"(class {model_cfg.get('class_name')!r})")
+        cfg = model_cfg["config"]
+        layer_cfgs = cfg["layers"]
+        in_names = _io_layer_names(cfg.get("input_layers"))
+        out_names = _io_layer_names(cfg.get("output_layers"))
+
+        dim_ordering = _detect_dim_ordering(layer_cfgs)
+        tr = KerasLayerTranslator(dim_ordering, enforce=enforce_training_config)
+
+        b = (NeuralNetConfiguration(seed=12345, activation="identity",
+                                    weight_init="xavier")
+             .graph_builder())
+        b.add_inputs(*in_names)
+
+        # name -> resolved vertex name (structural layers dissolve to their
+        # producer, like the reference's preprocessor-only KerasLayer merge).
+        resolved: Dict[str, str] = {n: n for n in in_names}
+        input_types: Dict[str, Any] = {}
+        keras_name_of: Dict[str, str] = {}   # vertex name -> keras layer name
+
+        _MERGE = {"Concatenate": "concat", "Merge": None, "Add": "add",
+                  "Average": "average", "Maximum": "max", "Subtract": "subtract",
+                  "Multiply": "product"}
+
+        for lc in layer_cfgs:
+            klass = lc["class_name"]
+            c = lc.get("config", {})
+            name = c.get("name") or lc.get("name")
+            inbound = [n for node in lc.get("inbound_nodes", [])
+                       for n in _inbound_names(node)]
+            srcs = [resolved[n] for n in inbound]
+            if klass == "InputLayer":
+                it = _input_type_from(c, dim_ordering)
+                if it is not None:
+                    input_types[name] = it
+                resolved[name] = name
+                continue
+            if klass in ("Flatten", "Reshape"):
+                resolved[name] = srcs[0]
+                continue
+            if klass in _MERGE:
+                mode = _MERGE[klass]
+                if klass == "Merge":  # keras-1 Merge(mode=...)
+                    m = c.get("mode", "concat")
+                    mode = {"sum": "add", "concat": "concat", "mul": "product",
+                            "ave": "average", "max": "max"}.get(m)
+                    if mode is None:
+                        raise ValueError(f"Unsupported Merge mode {m!r}")
+                if mode == "concat":
+                    b.add_vertex(name, MergeVertex(), *srcs)
+                else:
+                    b.add_vertex(name, ElementWiseVertex(op=mode), *srcs)
+                resolved[name] = name
+                keras_name_of[name] = name
+                continue
+            is_out = name in out_names
+            out_loss = _loss_for_output(loss, name, out_names.index(name)) \
+                if is_out else None
+            conf = tr.translate(klass, c, is_out, out_loss)
+            if conf is None:
+                resolved[name] = srcs[0]
+                continue
+            if klass == "LSTM" and not c.get("return_sequences", False):
+                # keras LSTM(return_sequences=False) emits [B,H] at the last
+                # step; our LSTM emits the whole sequence -> append the
+                # LastTimeStep vertex (reference rnn/LastTimeStepVertex).
+                b.add_layer(name + "__seq", conf, *srcs)
+                b.add_vertex(name, LastTimeStepVertex(), name + "__seq")
+                keras_name_of[name + "__seq"] = name
+                resolved[name] = name
+                continue
+            b.add_layer(name, conf, *srcs)
+            keras_name_of[name] = name
+            resolved[name] = name
+
+        b.set_outputs(*[resolved[n] for n in out_names])
+        if len(input_types) == len(in_names):
+            b.set_input_types(*[input_types[n] for n in in_names])
+        graph = ComputationGraph(b.build()).init()
+
+        weights = _collect_weights(f, list(keras_name_of.values()))
+        _copy_weights_cg(graph, keras_name_of, weights, dim_ordering)
+    return graph
+
+
+def _copy_weights_cg(graph, keras_name_of, weights, dim_ordering):
+    params = [dict(p) for p in graph.params]
+    state = [dict(s) for s in graph.state]
+    for vi, vname in enumerate(graph.vertex_names):
+        kname = keras_name_of.get(vname)
+        if kname is None or kname not in weights:
+            continue
+        layer = graph.vertices[vi].layer
+        if layer is None:
+            continue
+        _assign_layer_arrays(layer, weights[kname], params[vi], state[vi],
+                             dim_ordering)
+    graph.params = tuple(params)
+    graph.state = tuple(state)
+    graph.opt_state = graph.updater.init(graph.params)
+
+
+def import_keras_model(path: str, *, enforce_training_config=False):
     """Reference KerasModelImport.importKerasModelAndWeights: sniff
     Sequential vs functional."""
     import h5py
@@ -289,6 +528,7 @@ def import_keras_model(path: str):
             raise ValueError(f"{path}: no model_config")
         cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
     if cfg.get("class_name") == "Sequential":
-        return import_keras_sequential_model_and_weights(path)
-    raise NotImplementedError("Functional Keras model import lands next round "
-                              "(reference KerasModel.java:418)")
+        return import_keras_sequential_model_and_weights(
+            path, enforce_training_config=enforce_training_config)
+    return import_keras_model_and_weights(
+        path, enforce_training_config=enforce_training_config)

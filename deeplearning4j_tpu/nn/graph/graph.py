@@ -128,7 +128,15 @@ class ComputationGraph:
             v = self.vertices[vi]
             if not (isinstance(v, LayerVertex)
                     and isinstance(v.layer_conf, BaseOutputLayerMixin)):
-                raise ValueError(f"Network output {out_name!r} is not an output layer")
+                # The reference allows any vertex as a network output
+                # (ComputationGraph.java: outputs need not be IOutputLayer);
+                # only SCORING against labels requires a loss-bearing layer.
+                if k < len(labels) and labels[k] is not None:
+                    raise ValueError(
+                        f"Network output {out_name!r} is not an output layer; "
+                        f"it can be predicted via output() but not scored "
+                        f"against labels")
+                continue
             feed_name = self.conf.vertex_inputs[out_name][0]
             feed = (acts[feed_name] if feed_name not in self.conf.network_inputs
                     else inputs[self.conf.network_inputs.index(feed_name)])

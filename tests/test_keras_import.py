@@ -63,3 +63,148 @@ def test_import_via_model_guesser(tmp_path):
     _save_h5(model, path)
     net = import_keras_model(path)
     assert np.asarray(net.output(np.zeros((1, 6), np.float32))).shape == (1, 2)
+
+
+def test_import_functional_branching(tmp_path):
+    """Two-branch functional model: Add + Concatenate merge vertices
+    (reference KerasModel.java:418 topo-sorted layer graph -> vertices)."""
+    from keras import layers
+    inp = keras.Input(shape=(8,))
+    a = layers.Dense(4, activation="relu", name="d1")(inp)
+    b = layers.Dense(4, activation="tanh", name="d2")(inp)
+    m = layers.Add(name="add")([a, b])
+    c = layers.Concatenate(name="cat")([m, a])
+    out = layers.Dense(3, activation="softmax", name="out")(c)
+    model = keras.Model(inp, out)
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "func.h5")
+    _save_h5(model, path)
+
+    from deeplearning4j_tpu.keras_import.importer import import_keras_model_and_weights
+    graph = import_keras_model_and_weights(path)
+    x = np.random.default_rng(2).normal(size=(5, 8)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(graph.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-5), np.abs(keras_out - ours).max()
+
+
+def test_import_functional_cnn_residual(tmp_path):
+    """Mini residual CNN (conv + BN + add + global pool), the ResNet-50
+    building-block shape, via the sniffing entry point."""
+    from keras import layers
+    inp = keras.Input(shape=(8, 8, 3))
+    c1 = layers.Conv2D(4, (3, 3), padding="same", name="c1")(inp)
+    bn = layers.BatchNormalization(name="bn")(c1)
+    r = layers.Activation("relu", name="act")(bn)
+    c2 = layers.Conv2D(4, (3, 3), padding="same", name="c2")(r)
+    sc = layers.Conv2D(4, (1, 1), padding="same", name="sc")(inp)
+    s = layers.Add(name="add")([c2, sc])
+    g = layers.GlobalAveragePooling2D(name="gap")(s)
+    out = layers.Dense(2, activation="softmax", name="out")(g)
+    model = keras.Model(inp, out)
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "rescnn.h5")
+    _save_h5(model, path)
+
+    graph = import_keras_model(path)
+    x = np.random.default_rng(3).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(graph.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-4), np.abs(keras_out - ours).max()
+
+
+def test_import_functional_multi_input_output(tmp_path):
+    from keras import layers
+    in1 = keras.Input(shape=(4,), name="in1")
+    in2 = keras.Input(shape=(6,), name="in2")
+    h1 = layers.Dense(5, activation="relu", name="h1")(in1)
+    h2 = layers.Dense(5, activation="relu", name="h2")(in2)
+    m = layers.Concatenate(name="cat")([h1, h2])
+    o1 = layers.Dense(3, activation="softmax", name="o1")(m)
+    o2 = layers.Dense(1, activation="linear", name="o2")(m)
+    model = keras.Model([in1, in2], [o1, o2])
+    model.compile(loss={"o1": "categorical_crossentropy", "o2": "mse"},
+                  optimizer="sgd")
+    path = str(tmp_path / "mimo.h5")
+    _save_h5(model, path)
+
+    graph = import_keras_model(path)
+    rng = np.random.default_rng(4)
+    x1 = rng.normal(size=(3, 4)).astype(np.float32)
+    x2 = rng.normal(size=(3, 6)).astype(np.float32)
+    k1, k2 = model.predict([x1, x2], verbose=0)
+    ours = graph.output(x1, x2)
+    assert np.allclose(np.asarray(k1), np.asarray(ours[0]), atol=1e-5)
+    assert np.allclose(np.asarray(k2), np.asarray(ours[1]), atol=1e-5)
+
+
+def test_import_sequential_dense_plus_activation_head(tmp_path):
+    """Dense(linear) + Activation('softmax') tail imports as a proper scoring
+    layer instead of mis-assigning the loss to the Dense."""
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(4,)),
+        layers.Dense(3),
+        layers.Activation("softmax"),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "densact.h5")
+    _save_h5(model, path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(5).normal(size=(4, 4)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-5)
+    # the imported net must be trainable (loss wired to the activation head)
+    y = np.eye(3)[np.random.default_rng(6).integers(0, 3, 4)]
+    s = net.score(x, y)
+    assert np.isfinite(s)
+
+
+def test_enforce_training_config_raises_on_unknown_loss(tmp_path):
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(4,)),
+        layers.Dense(2, activation="softmax"),
+    ])
+    model.compile(loss="huber", optimizer="sgd")
+    path = str(tmp_path / "huber.h5")
+    _save_h5(model, path)
+    with pytest.raises(ValueError, match="huber"):
+        import_keras_sequential_model_and_weights(path, enforce_training_config=True)
+    net = import_keras_sequential_model_and_weights(path)  # lenient default
+    assert np.asarray(net.output(np.zeros((1, 4), np.float32))).shape == (1, 2)
+
+
+def test_import_functional_lstm_last_step(tmp_path):
+    from keras import layers
+    inp = keras.Input(shape=(7, 5))
+    h = layers.LSTM(6, return_sequences=False, name="enc")(inp)
+    out = layers.Dense(2, activation="softmax", name="out")(h)
+    model = keras.Model(inp, out)
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "lstm.h5")
+    _save_h5(model, path)
+    graph = import_keras_model(path)
+    x = np.random.default_rng(7).normal(size=(3, 7, 5)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(graph.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-4), np.abs(keras_out - ours).max()
+
+
+@pytest.mark.slow
+def test_import_keras_applications_resnet50_vgg16(tmp_path):
+    """North-star (SURVEY §7 stage 8): real keras.applications ResNet-50 and
+    VGG16 functional .h5 files import unchanged and predict identically."""
+    import numpy as np
+    for name, ctor in [("resnet50", keras.applications.ResNet50),
+                       ("vgg16", keras.applications.VGG16)]:
+        model = ctor(weights=None, classes=10, input_shape=(64, 64, 3),
+                     include_top=True)
+        path = str(tmp_path / f"{name}.h5")
+        _save_h5(model, path)
+        graph = import_keras_model(path)
+        x = np.random.default_rng(0).normal(size=(2, 64, 64, 3)).astype(np.float32)
+        k = np.asarray(model.predict(x, verbose=0))
+        o = np.asarray(graph.output(x))
+        assert np.allclose(k, o, atol=1e-4), np.abs(k - o).max()

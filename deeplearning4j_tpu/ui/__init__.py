@@ -1,0 +1,12 @@
+"""Observability tier: stats collection, storage, dashboard (reference
+deeplearning4j-ui-parent)."""
+from .dashboard import TrainingUIServer, render_dashboard, render_dashboard_html
+from .stats import StatsListener, StatsUpdateConfiguration
+from .storage import (FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+                      StatsStorageEvent)
+
+__all__ = [
+    "StatsListener", "StatsUpdateConfiguration", "StatsStorage",
+    "InMemoryStatsStorage", "FileStatsStorage", "StatsStorageEvent",
+    "render_dashboard", "render_dashboard_html", "TrainingUIServer",
+]

@@ -1,0 +1,265 @@
+"""Training dashboard: standalone HTML artifact + live stdlib HTTP server.
+
+Reference: deeplearning4j-ui-parent/deeplearning4j-play (UIServer.getInstance()
+.attach(statsStorage) serving the train overview: score chart, param/update
+ratios, histograms, system tab). The capability is reproduced with zero
+dependencies: the page is a single self-contained HTML file (inline JSON +
+hand-rolled SVG charts), and `TrainingUIServer` serves a live re-rendered
+copy from any StatsStorage with auto-refresh.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import math
+import threading
+from typing import List, Optional
+
+from .storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
+{refresh}
+<style>
+ body {{ font-family: -apple-system, Segoe UI, Helvetica, Arial, sans-serif;
+        margin: 24px; background: #fafafa; color: #1a1a1a; }}
+ h1 {{ font-size: 20px; }} h2 {{ font-size: 15px; margin: 18px 0 6px; }}
+ .card {{ background: #fff; border: 1px solid #e3e3e3; border-radius: 8px;
+          padding: 12px 16px; margin-bottom: 16px; }}
+ table {{ border-collapse: collapse; font-size: 13px; }}
+ td, th {{ padding: 3px 10px; border-bottom: 1px solid #eee; text-align: left; }}
+ svg text {{ font-size: 10px; fill: #666; }}
+ .meta {{ color: #666; font-size: 12px; }}
+</style></head><body>
+<h1>Training overview <span class="meta">session {session} · worker {worker}</span></h1>
+<div class="card"><h2>Model</h2>{static_table}</div>
+<div class="card"><h2>Score vs. iteration</h2>{score_chart}</div>
+<div class="card"><h2>Throughput (iterations/sec)</h2>{speed_chart}</div>
+<div class="card"><h2>Mean magnitudes: parameters</h2>{param_chart}</div>
+<div class="card"><h2>Update : parameter ratio (log10)</h2>{ratio_chart}</div>
+{hist_cards}
+<script type="application/json" id="stats-data">{data_json}</script>
+</body></html>
+"""
+
+
+def _svg_line_chart(series: List[tuple], width=720, height=220, logy=False):
+    """series: [(label, [(x, y), ...])]. Hand-rolled SVG polyline chart."""
+    pts_all = [p for _, pts in series for p in pts]
+    if not pts_all:
+        return "<p class='meta'>no data yet</p>"
+    xs = [p[0] for p in pts_all]
+    ys = [p[1] for p in pts_all if p[1] is not None and math.isfinite(p[1])]
+    if not ys:
+        return "<p class='meta'>no finite data</p>"
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    if x1 == x0:
+        x1 = x0 + 1
+    if y1 == y0:
+        y1 = y0 + (abs(y0) if y0 else 1) * 0.1 + 1e-12
+    pad = 40
+    W, H = width - pad - 10, height - 30
+    colors = ["#2563eb", "#dc2626", "#059669", "#d97706", "#7c3aed",
+              "#0891b2", "#be185d", "#4d7c0f", "#b91c1c", "#1e40af"]
+
+    def sx(x):
+        return pad + (x - x0) / (x1 - x0) * W
+
+    def sy(y):
+        return 5 + (1 - (y - y0) / (y1 - y0)) * H
+
+    parts = [f'<svg width="{width}" height="{height}" '
+             f'xmlns="http://www.w3.org/2000/svg">']
+    # axes + gridlines
+    for i in range(5):
+        gy = 5 + i * H / 4
+        val = y1 - i * (y1 - y0) / 4
+        parts.append(f'<line x1="{pad}" y1="{gy:.1f}" x2="{width-10}" '
+                     f'y2="{gy:.1f}" stroke="#eee"/>')
+        parts.append(f'<text x="2" y="{gy+3:.1f}">{val:.3g}</text>')
+    parts.append(f'<text x="{pad}" y="{height-5}">{x0:g}</text>')
+    parts.append(f'<text x="{width-60}" y="{height-5}">{x1:g}</text>')
+    legend_x = pad
+    for i, (label, pts) in enumerate(series):
+        c = colors[i % len(colors)]
+        poly = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts
+                        if y is not None and math.isfinite(y))
+        parts.append(f'<polyline fill="none" stroke="{c}" stroke-width="1.5" '
+                     f'points="{poly}"/>')
+        if len(series) > 1:
+            parts.append(f'<rect x="{legend_x}" y="{height-24}" width="8" '
+                         f'height="8" fill="{c}"/>')
+            parts.append(f'<text x="{legend_x+11}" y="{height-16}">{label}</text>')
+            legend_x += 11 + 7 * len(label) + 14
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _svg_histogram(hist: dict, width=340, height=120):
+    counts = hist.get("counts", [])
+    if not counts:
+        return ""
+    lo, hi = hist.get("lo", 0.0), hist.get("hi", 1.0)
+    mx = max(counts) or 1
+    n = len(counts)
+    pad, W, H = 4, width - 8, height - 22
+    bw = W / n
+    parts = [f'<svg width="{width}" height="{height}" '
+             f'xmlns="http://www.w3.org/2000/svg">']
+    for i, c in enumerate(counts):
+        h = c / mx * H
+        parts.append(f'<rect x="{pad+i*bw:.1f}" y="{4+H-h:.1f}" '
+                     f'width="{max(bw-1,1):.1f}" height="{h:.1f}" fill="#2563eb"/>')
+    parts.append(f'<text x="{pad}" y="{height-6}">{lo:.3g}</text>')
+    parts.append(f'<text x="{width-50}" y="{height-6}">{hi:.3g}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,
+                          worker_id: Optional[str] = None,
+                          auto_refresh_sec: int = 0) -> str:
+    sessions = storage.list_session_ids()
+    if session_id is None:
+        session_id = sessions[-1] if sessions else ""
+    workers = storage.list_worker_ids(session_id) if session_id else []
+    if worker_id is None:
+        worker_id = workers[0] if workers else ""
+    static = storage.get_static_info(session_id, worker_id) or {}
+    updates = storage.get_updates(session_id, worker_id)
+
+    rows = "".join(f"<tr><th>{k}</th><td>{v}</td></tr>"
+                   for k, v in static.items() if k != "param_names")
+    static_table = f"<table>{rows}</table>" if rows else "<p class='meta'>–</p>"
+
+    score_pts = [(u["iteration"], u.get("score")) for u in updates
+                 if "score" in u]
+    speed_pts = [(u["iteration"], u.get("iterations_per_sec")) for u in updates
+                 if "iterations_per_sec" in u]
+    # per-param mean-magnitude series
+    pnames = sorted({n for u in updates for n in u.get("params", {})})
+    param_series = [(n, [(u["iteration"], u["params"][n]["meanmag"])
+                         for u in updates if n in u.get("params", {})])
+                    for n in pnames[:10]]
+    ratio_series = []
+    for n in pnames[:10]:
+        pts = []
+        for u in updates:
+            if n in u.get("params", {}) and n in u.get("updates", {}):
+                pm = u["params"][n]["meanmag"]
+                um = u["updates"][n]["meanmag"]
+                if pm > 0 and um > 0:
+                    pts.append((u["iteration"], math.log10(um / pm)))
+        if pts:
+            ratio_series.append((n, pts))
+
+    hist_cards = ""
+    last_with_hist = next((u for u in reversed(updates)
+                           if any("histogram" in d
+                                  for d in u.get("params", {}).values())), None)
+    if last_with_hist:
+        cells = []
+        for n, d in list(last_with_hist["params"].items())[:12]:
+            if "histogram" in d:
+                cells.append(f"<div style='display:inline-block;margin:4px'>"
+                             f"<div class='meta'>{n}</div>"
+                             f"{_svg_histogram(d['histogram'])}</div>")
+        hist_cards = ("<div class='card'><h2>Parameter histograms "
+                      f"(iteration {last_with_hist['iteration']})</h2>"
+                      + "".join(cells) + "</div>")
+
+    refresh = (f'<meta http-equiv="refresh" content="{auto_refresh_sec}">'
+               if auto_refresh_sec else "")
+    return _PAGE.format(
+        refresh=refresh, session=session_id or "–", worker=worker_id or "–",
+        static_table=static_table,
+        score_chart=_svg_line_chart([("score", score_pts)]),
+        speed_chart=_svg_line_chart([("it/s", speed_pts)]),
+        param_chart=_svg_line_chart(param_series),
+        ratio_chart=_svg_line_chart(ratio_series),
+        hist_cards=hist_cards,
+        data_json=json.dumps({"session": session_id, "worker": worker_id,
+                              "n_updates": len(updates)}),
+    )
+
+
+def render_dashboard(storage: StatsStorage, path: str,
+                     session_id: Optional[str] = None,
+                     worker_id: Optional[str] = None) -> str:
+    """Write the dashboard artifact to `path`; returns the path."""
+    html = render_dashboard_html(storage, session_id, worker_id)
+    with open(path, "w") as f:
+        f.write(html)
+    return path
+
+
+class TrainingUIServer:
+    """Live dashboard over a StatsStorage (reference UIServer.getInstance();
+    play framework replaced by the stdlib ThreadingHTTPServer — the page is
+    re-rendered per request and auto-refreshes).
+    """
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "TrainingUIServer":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self, port: int = 0):
+        self._storages: List[StatsStorage] = []
+        self._port = port
+        self._httpd = None
+        self._thread = None
+
+    def attach(self, storage: StatsStorage):
+        self._storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage):
+        self._storages.remove(storage)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if not server._storages:
+                    body = b"<html><body>no storage attached</body></html>"
+                else:
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    sid = q.get("session", [None])[0]
+                    wid = q.get("worker", [None])[0]
+                    body = render_dashboard_html(
+                        server._storages[-1], sid, wid,
+                        auto_refresh_sec=5).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", self._port),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if TrainingUIServer._instance is self:
+            TrainingUIServer._instance = None

@@ -1,0 +1,198 @@
+"""StatsStorage SPI + in-memory and file-backed implementations.
+
+Reference: deeplearning4j-ui-parent/deeplearning4j-ui-model/src/main/java/org/
+deeplearning4j/api/storage/StatsStorage.java (SPI: listSessionIDs,
+getAllUpdatesAfter, getStaticInfo, listeners) with InMemoryStatsStorage and
+FileStatsStorage (MapDB) as the stock backends.
+
+TPU-first reshape: records are plain JSON-able dicts (the reference's SBE
+binary encoding existed to cross the JVM/Play boundary; here the dashboard
+consumes JSON directly). The file backend is append-only JSON-lines, so a
+training run can stream to disk and a dashboard process can tail it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class StatsStorageEvent:
+    """Posted to registered listeners (reference StatsStorageEvent.java)."""
+
+    NEW_SESSION = "new_session"
+    NEW_WORKER = "new_worker"
+    POST_STATIC = "post_static"
+    POST_UPDATE = "post_update"
+
+    def __init__(self, kind: str, session_id: str, worker_id: str,
+                 timestamp: float):
+        self.kind = kind
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.timestamp = timestamp
+
+
+class StatsStorage:
+    """Abstract storage for training stats (reference StatsStorage.java SPI)."""
+
+    def __init__(self):
+        self._listeners: List[Callable[[StatsStorageEvent], None]] = []
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- write side
+    def put_static_info(self, session_id: str, worker_id: str,
+                        info: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def put_update(self, session_id: str, worker_id: str,
+                   update: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- read side
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_worker_ids(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str,
+                        worker_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str, worker_id: str,
+                    since_iteration: int = -1) -> List[Dict[str, Any]]:
+        """All updates with iteration > since_iteration, ordered by iteration
+        (reference getAllUpdatesAfter)."""
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str,
+                          worker_id: str) -> Optional[Dict[str, Any]]:
+        ups = self.get_updates(session_id, worker_id)
+        return ups[-1] if ups else None
+
+    # -------------------------------------------------------------- listeners
+    def register_listener(self, cb: Callable[[StatsStorageEvent], None]):
+        self._listeners.append(cb)
+
+    def _notify(self, kind: str, session_id: str, worker_id: str):
+        ev = StatsStorageEvent(kind, session_id, worker_id, time.time())
+        for cb in list(self._listeners):
+            cb(ev)
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference InMemoryStatsStorage.java — dict-backed, test/dev default."""
+
+    def __init__(self):
+        super().__init__()
+        self._static: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._updates: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+
+    def put_static_info(self, session_id, worker_id, info):
+        with self._lock:
+            new_session = not any(s == session_id for s, _ in self._static)
+            self._static[(session_id, worker_id)] = dict(info)
+        if new_session:
+            self._notify(StatsStorageEvent.NEW_SESSION, session_id, worker_id)
+        self._notify(StatsStorageEvent.POST_STATIC, session_id, worker_id)
+
+    def put_update(self, session_id, worker_id, update):
+        with self._lock:
+            self._updates.setdefault((session_id, worker_id), []).append(dict(update))
+        self._notify(StatsStorageEvent.POST_UPDATE, session_id, worker_id)
+
+    def list_session_ids(self):
+        with self._lock:
+            keys = set(s for s, _ in self._static) | set(s for s, _ in self._updates)
+        return sorted(keys)
+
+    def list_worker_ids(self, session_id):
+        with self._lock:
+            keys = set(w for s, w in self._static if s == session_id)
+            keys |= set(w for s, w in self._updates if s == session_id)
+        return sorted(keys)
+
+    def get_static_info(self, session_id, worker_id):
+        with self._lock:
+            return self._static.get((session_id, worker_id))
+
+    def get_updates(self, session_id, worker_id, since_iteration=-1):
+        with self._lock:
+            ups = list(self._updates.get((session_id, worker_id), []))
+        return [u for u in ups if u.get("iteration", 0) > since_iteration]
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSON-lines file storage (capability of the reference's
+    MapDB-backed FileStatsStorage.java, in a tail-able text format).
+
+    Each line: {"kind": "static"|"update", "session": .., "worker": ..,
+    "data": {...}}. Reads re-scan the file, so an independent dashboard
+    process sees a live training run's appends.
+    """
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # touch so readers don't race a missing file
+        if not os.path.exists(path):
+            with open(path, "a"):
+                pass
+
+    def _append(self, rec: Dict[str, Any]):
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def _scan(self):
+        with self._lock:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write from a live run
+
+    def put_static_info(self, session_id, worker_id, info):
+        known = session_id in self.list_session_ids()
+        self._append({"kind": "static", "session": session_id,
+                      "worker": worker_id, "data": info})
+        if not known:
+            self._notify(StatsStorageEvent.NEW_SESSION, session_id, worker_id)
+        self._notify(StatsStorageEvent.POST_STATIC, session_id, worker_id)
+
+    def put_update(self, session_id, worker_id, update):
+        self._append({"kind": "update", "session": session_id,
+                      "worker": worker_id, "data": update})
+        self._notify(StatsStorageEvent.POST_UPDATE, session_id, worker_id)
+
+    def list_session_ids(self):
+        return sorted({r["session"] for r in self._scan()})
+
+    def list_worker_ids(self, session_id):
+        return sorted({r["worker"] for r in self._scan()
+                       if r["session"] == session_id})
+
+    def get_static_info(self, session_id, worker_id):
+        out = None
+        for r in self._scan():
+            if (r["kind"] == "static" and r["session"] == session_id
+                    and r["worker"] == worker_id):
+                out = r["data"]  # last write wins
+        return out
+
+    def get_updates(self, session_id, worker_id, since_iteration=-1):
+        out = [r["data"] for r in self._scan()
+               if (r["kind"] == "update" and r["session"] == session_id
+                   and r["worker"] == worker_id)]
+        return [u for u in out if u.get("iteration", 0) > since_iteration]

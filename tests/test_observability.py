@@ -1,0 +1,146 @@
+"""Observability tier tests: StatsListener -> StatsStorage -> dashboard.
+
+Reference test strategy: deeplearning4j-ui-parent tests (TestStatsListener,
+TestStatsStorage) — collect stats from a real training run, round-trip them
+through storage, render the UI.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, StatsStorageEvent,
+                                   StatsUpdateConfiguration, TrainingUIServer,
+                                   render_dashboard)
+
+
+def _tiny_net(seed=12):
+    conf = (NeuralNetConfiguration(seed=seed, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(rng, n=64):
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=n)]
+    return x, y
+
+
+def test_stats_listener_collects_into_memory_storage(rng):
+    net = _tiny_net()
+    storage = InMemoryStatsStorage()
+    cfg = StatsUpdateConfiguration(report_frequency=1, collect_histograms=True,
+                                   histogram_bins=10)
+    listener = StatsListener(storage, config=cfg, session_id="sess1")
+    net.set_listeners(listener)
+    x, y = _toy_data(rng)
+    net.fit(x, y, epochs=2, batch_size=16)
+
+    assert storage.list_session_ids() == ["sess1"]
+    workers = storage.list_worker_ids("sess1")
+    assert workers == ["worker_0"]
+    static = storage.get_static_info("sess1", "worker_0")
+    assert static["model_class"] == "MultiLayerNetwork"
+    assert static["num_params"] == 4 * 8 + 8 + 8 * 3 + 3
+    assert len(static["param_names"]) == 4  # 0/W 0/b 1/W 1/b
+
+    updates = storage.get_updates("sess1", "worker_0")
+    assert len(updates) == 8  # 64/16 * 2 epochs
+    u = updates[-1]
+    assert "score" in u and np.isfinite(u["score"])
+    assert set(u["params"]) == set(static["param_names"])
+    pw = u["params"]["0/W"]
+    assert {"mean", "stdev", "meanmag", "min", "max"} <= set(pw)
+    # histogram counts must account for every element of the leaf
+    assert sum(pw["histogram"]["counts"]) == 4 * 8
+    # update stats present from the second report on
+    assert "updates" in u and u["updates"]["0/W"]["meanmag"] > 0
+    # get_updates(since) filters
+    later = storage.get_updates("sess1", "worker_0",
+                                since_iteration=u["iteration"] - 1)
+    assert [v["iteration"] for v in later] == [u["iteration"]]
+
+
+def test_file_stats_storage_round_trip(tmp_path, rng):
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    net = _tiny_net()
+    net.set_listeners(StatsListener(storage, session_id="fsess"))
+    x, y = _toy_data(rng, n=32)
+    net.fit(x, y, epochs=1, batch_size=16)
+
+    # independent reader process sees the same data (fresh instance, same file)
+    reader = FileStatsStorage(path)
+    assert reader.list_session_ids() == ["fsess"]
+    ups = reader.get_updates("fsess", "worker_0")
+    assert len(ups) == 2
+    assert reader.get_static_info("fsess", "worker_0")["num_params"] > 0
+    # file really is JSON-lines
+    with open(path) as f:
+        kinds = [json.loads(line)["kind"] for line in f]
+    assert kinds[0] == "static" and kinds.count("update") == 2
+
+
+def test_storage_events_fire(rng):
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_listener(lambda ev: events.append(ev.kind))
+    storage.put_static_info("s", "w", {"a": 1})
+    storage.put_update("s", "w", {"iteration": 0, "score": 1.0})
+    assert StatsStorageEvent.NEW_SESSION in events
+    assert StatsStorageEvent.POST_UPDATE in events
+
+
+def test_render_dashboard_artifact(tmp_path, rng):
+    net = _tiny_net()
+    storage = InMemoryStatsStorage()
+    cfg = StatsUpdateConfiguration(collect_histograms=True)
+    net.set_listeners(StatsListener(storage, config=cfg, session_id="dash"))
+    x, y = _toy_data(rng)
+    net.fit(x, y, epochs=1, batch_size=16)
+
+    out = render_dashboard(storage, str(tmp_path / "train.html"))
+    html = open(out).read()
+    assert "<svg" in html            # charts rendered
+    assert "Score vs. iteration" in html
+    assert "Parameter histograms" in html
+    assert "dash" in html
+
+
+def test_training_ui_server_serves_live_page(rng):
+    net = _tiny_net()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, session_id="live"))
+    x, y = _toy_data(rng, n=32)
+    net.fit(x, y, epochs=1, batch_size=16)
+
+    server = TrainingUIServer()
+    server.attach(storage)
+    port = server.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=5) as r:
+            body = r.read().decode()
+        assert r.status == 200
+        assert "Training overview" in body and "live" in body
+    finally:
+        server.stop()
+
+
+def test_activation_stats_optional(rng):
+    x, y = _toy_data(rng, n=32)
+    net = _tiny_net()
+    storage = InMemoryStatsStorage()
+    cfg = StatsUpdateConfiguration(collect_activation_stats=True)
+    net.set_listeners(StatsListener(storage, config=cfg, session_id="act",
+                                    activation_sample=x[:8]))
+    net.fit(x, y, epochs=1, batch_size=16)
+    u = storage.get_latest_update("act", "worker_0")
+    assert "activations" in u and len(u["activations"]) >= 2
+    assert all(np.isfinite(v) for v in u["activations"].values())

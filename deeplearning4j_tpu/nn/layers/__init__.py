@@ -7,8 +7,16 @@ from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
 from .norm import BatchNormalization, LocalResponseNormalization
 from .recurrent import (GravesBidirectionalLSTM, GravesLSTM, LSTM,
                         LastTimeStepLayer)
+from .variational import (BernoulliReconstructionDistribution,
+                          CompositeReconstructionDistribution,
+                          ExponentialReconstructionDistribution,
+                          GaussianReconstructionDistribution,
+                          LossFunctionWrapper, RBM, VariationalAutoencoder)
 
 __all__ = [
+    "BernoulliReconstructionDistribution", "CompositeReconstructionDistribution",
+    "ExponentialReconstructionDistribution", "GaussianReconstructionDistribution",
+    "LossFunctionWrapper", "RBM", "VariationalAutoencoder",
     "LayerConf", "ActivationLayer", "AutoEncoder", "CenterLossOutputLayer",
     "DenseLayer", "DropoutLayer", "EmbeddingLayer", "LossLayer", "OutputLayer",
     "RnnOutputLayer", "Convolution1DLayer", "ConvolutionLayer",

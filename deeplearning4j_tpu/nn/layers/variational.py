@@ -1,0 +1,452 @@
+"""Variational autoencoder + RBM: the unsupervised pretrain layer family.
+
+Reference parity:
+- VariationalAutoencoder conf  -> nn/conf/layers/variational/VariationalAutoencoder.java
+- VariationalAutoencoder impl  -> nn/layers/variational/VariationalAutoencoder.java
+  (1,156 LoC: encoder/decoder stacks, reparameterized ELBO pretraining
+  :computeGradientAndScore, supervised forward = mean of q(z|x) :activate,
+  reconstructionLogProbability / generateAtMean / generateRandom APIs)
+- Reconstruction distributions -> nn/conf/layers/variational/
+  {GaussianReconstructionDistribution, BernoulliReconstructionDistribution,
+   ExponentialReconstructionDistribution, CompositeReconstructionDistribution,
+   LossFunctionWrapper}.java
+- RBM conf/impl                -> nn/conf/layers/RBM.java +
+  nn/layers/feedforward/rbm/RBM.java (contrastive divergence, Gibbs sampling,
+  HiddenUnit/VisibleUnit types)
+
+TPU-first design notes: the whole ELBO (encoder stack, reparameterized
+sampling over ``num_samples`` draws, decoder stack, reconstruction
+log-likelihood, KL) is ONE pure function — jax.grad differentiates it and XLA
+fuses the stacks into back-to-back MXU matmuls; the reference hand-derives the
+backward pass over ~400 lines. CD-k for the RBM is expressed as a free-energy
+surrogate loss whose jax.grad IS the CD-k update (positive phase minus
+stop-gradient negative phase), so the same jitted pretrain path drives it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+from ..activations import get_activation
+from ..inputs import InputTypeFeedForward
+from ..losses import get_loss
+from .base import LayerConf, maybe_dropout, resolve_ff_size
+
+
+# --------------------------------------------------------------------------
+# Reconstruction distributions p(x|z)
+# --------------------------------------------------------------------------
+
+@register
+@dataclass
+class GaussianReconstructionDistribution:
+    """p(x|z) = N(mu, sigma^2) with [mu | log sigma^2] produced by the decoder
+    (reference GaussianReconstructionDistribution.java: distributionInputSize
+    = 2*dataSize; activation applied to the mean half only)."""
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return 2 * data_size
+
+    def _split(self, pre):
+        d = pre.shape[-1] // 2
+        mu = get_activation(self.activation)(pre[..., :d])
+        log_var = pre[..., d:]
+        return mu, log_var
+
+    def neg_log_prob(self, x, pre):
+        mu, log_var = self._split(pre)
+        var = jnp.exp(log_var)
+        ll = -0.5 * (jnp.log(2 * jnp.pi) + log_var + (x - mu) ** 2 / var)
+        return -jnp.sum(ll, axis=-1)
+
+    def generate_at_mean(self, pre):
+        return self._split(pre)[0]
+
+    def generate_random(self, rng, pre):
+        mu, log_var = self._split(pre)
+        return mu + jnp.exp(0.5 * log_var) * jax.random.normal(rng, mu.shape, mu.dtype)
+
+
+@register
+@dataclass
+class BernoulliReconstructionDistribution:
+    """p(x|z) = Bernoulli(sigmoid(pre)) — binary/binarized data (reference
+    BernoulliReconstructionDistribution.java)."""
+    activation: str = "sigmoid"
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def neg_log_prob(self, x, pre):
+        if self.activation == "sigmoid":
+            # numerically stable fused form
+            ll = x * jax.nn.log_sigmoid(pre) + (1 - x) * jax.nn.log_sigmoid(-pre)
+        else:
+            p = jnp.clip(get_activation(self.activation)(pre), 1e-10, 1 - 1e-10)
+            ll = x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+        return -jnp.sum(ll, axis=-1)
+
+    def generate_at_mean(self, pre):
+        return get_activation(self.activation)(pre)
+
+    def generate_random(self, rng, pre):
+        p = get_activation(self.activation)(pre)
+        return jax.random.bernoulli(rng, p).astype(pre.dtype)
+
+
+@register
+@dataclass
+class ExponentialReconstructionDistribution:
+    """p(x|z) = lambda*exp(-lambda*x), lambda = exp(activation(pre))
+    (reference ExponentialReconstructionDistribution.java: gamma = preOut
+    through activation, lambda = exp(gamma); logP = gamma - x*exp(gamma))."""
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def neg_log_prob(self, x, pre):
+        gamma = get_activation(self.activation)(pre)
+        ll = gamma - x * jnp.exp(gamma)
+        return -jnp.sum(ll, axis=-1)
+
+    def generate_at_mean(self, pre):
+        gamma = get_activation(self.activation)(pre)
+        return jnp.exp(-gamma)     # mean = 1/lambda
+
+    def generate_random(self, rng, pre):
+        lam = jnp.exp(get_activation(self.activation)(pre))
+        u = jax.random.uniform(rng, pre.shape, pre.dtype, minval=1e-10, maxval=1.0)
+        return -jnp.log(u) / lam
+
+
+@register
+@dataclass
+class LossFunctionWrapper:
+    """Wraps a standard loss function as a (non-probabilistic) reconstruction
+    "distribution" (reference LossFunctionWrapper.java) — the VAE becomes an
+    unsupervised net trained on reconstruction error + KL."""
+    loss: str = "mse"
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def neg_log_prob(self, x, pre):
+        return get_loss(self.loss)(x, pre, self.activation, None)
+
+    def generate_at_mean(self, pre):
+        return get_activation(self.activation)(pre)
+
+    def generate_random(self, rng, pre):
+        return self.generate_at_mean(pre)
+
+
+@register
+@dataclass
+class CompositeReconstructionDistribution:
+    """Different distributions for column slices of the data (reference
+    CompositeReconstructionDistribution.java). ``parts`` is a list of
+    (data_size, distribution) pairs covering the input columns in order."""
+    parts: List[Any] = field(default_factory=list)    # [[size, dist], ...]
+
+    def input_size(self, data_size: int) -> int:
+        total = sum(int(s) for s, _ in self.parts)
+        if data_size != total:
+            raise ValueError(f"Composite part sizes sum to {total}, but the "
+                             f"layer input size is {data_size}")
+        return sum(d.input_size(int(s)) for s, d in self.parts)
+
+    def _slices(self):
+        x_off, p_off = 0, 0
+        for s, d in self.parts:
+            s = int(s)
+            ps = d.input_size(s)
+            yield (x_off, s, p_off, ps, d)
+            x_off += s
+            p_off += ps
+
+    def neg_log_prob(self, x, pre):
+        total = 0.0
+        for x0, xs, p0, ps, d in self._slices():
+            total = total + d.neg_log_prob(x[..., x0:x0 + xs], pre[..., p0:p0 + ps])
+        return total
+
+    def generate_at_mean(self, pre):
+        outs = [d.generate_at_mean(pre[..., p0:p0 + ps])
+                for _, _, p0, ps, d in self._slices()]
+        return jnp.concatenate(outs, axis=-1)
+
+    def generate_random(self, rng, pre):
+        outs = []
+        for _, _, p0, ps, d in self._slices():
+            rng, sub = jax.random.split(rng)
+            outs.append(d.generate_random(sub, pre[..., p0:p0 + ps]))
+        return jnp.concatenate(outs, axis=-1)
+
+
+# --------------------------------------------------------------------------
+# VariationalAutoencoder layer
+# --------------------------------------------------------------------------
+
+@register
+@dataclass
+class VariationalAutoencoder(LayerConf):
+    """VAE layer: pretrained on the reparameterized ELBO; as a layer in a
+    supervised stack its forward pass is mean(q(z|x)) through
+    ``pzx_activation`` (reference nn/layers/variational/
+    VariationalAutoencoder.java:activate — decoder params take no part and no
+    gradient in supervised backprop, mirrored here via ``supervised_params``).
+    """
+    n_in: Optional[int] = None
+    n_out: int = 0                                  # latent space size
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: Any = None          # default Gaussian(identity)
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def __post_init__(self):
+        if self.reconstruction_distribution is None:
+            self.reconstruction_distribution = GaussianReconstructionDistribution()
+        self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
+        self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+
+    # param layout (reference VariationalAutoencoderParamInitializer):
+    # encoder stack, q(z|x) mean + log-variance heads, decoder stack, p(x|z) head
+    @property
+    def param_order(self) -> Tuple[str, ...]:        # type: ignore[override]
+        names = []
+        for i in range(len(self.encoder_layer_sizes)):
+            names += [f"eW{i}", f"eb{i}"]
+        names += ["pZXMeanW", "pZXMeanb", "pZXLogStd2W", "pZXLogStd2b"]
+        for i in range(len(self.decoder_layer_sizes)):
+            names += [f"dW{i}", f"db{i}"]
+        names += ["pXZW", "pXZb"]
+        return tuple(names)
+
+    @property
+    def weight_param_names(self) -> Tuple[str, ...]:  # type: ignore[override]
+        """Weights subject to l1/l2 in the SUPERVISED loss: encoder + mean head
+        only. Decoder/logStd2/pXZ params are pretrain-only (reference
+        isPretrainParam) — penalizing them in a supervised stack would decay a
+        pretrained decoder that takes no part in the forward pass."""
+        return tuple(n for n in self.supervised_params() if "W" in n)
+
+    def supervised_params(self) -> Tuple[str, ...]:
+        """Params that participate in supervised forward/backprop (reference
+        isPretrainParam: decoder + pXZ + logStd2 head are pretrain-only)."""
+        names = []
+        for i in range(len(self.encoder_layer_sizes)):
+            names += [f"eW{i}", f"eb{i}"]
+        names += ["pZXMeanW", "pZXMeanb"]
+        return tuple(names)
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(self.n_out)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        self.n_in = n_in
+        dist_size = self.reconstruction_distribution.input_size(n_in)
+        params = {}
+
+        def dense(rng, name_w, name_b, fi, fo):
+            params[name_w] = self._winit(rng, (fi, fo), fi, fo, dtype)
+            params[name_b] = self._binit((fo,), dtype)
+
+        cur = n_in
+        for i, h in enumerate(self.encoder_layer_sizes):
+            rng, sub = jax.random.split(rng)
+            dense(sub, f"eW{i}", f"eb{i}", cur, h)
+            cur = h
+        rng, s1, s2 = jax.random.split(rng, 3)
+        dense(s1, "pZXMeanW", "pZXMeanb", cur, self.n_out)
+        dense(s2, "pZXLogStd2W", "pZXLogStd2b", cur, self.n_out)
+        cur = self.n_out
+        for i, h in enumerate(self.decoder_layer_sizes):
+            rng, sub = jax.random.split(rng)
+            dense(sub, f"dW{i}", f"db{i}", cur, h)
+            cur = h
+        rng, sub = jax.random.split(rng)
+        dense(sub, "pXZW", "pXZb", cur, dist_size)
+        return params, {}
+
+    # ---- encoder / decoder stacks ----
+    def _encoder_hidden(self, params, x):
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = self.act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+        return h
+
+    def encode(self, params, x):
+        """q(z|x): returns (mean, log_var), both through ``pzx_activation``
+        (reference preOut -> pzxActivationFn for both heads)."""
+        h = self._encoder_hidden(params, x)
+        pzx_act = get_activation(self.pzx_activation)
+        mu = pzx_act(h @ params["pZXMeanW"] + params["pZXMeanb"])
+        log_var = pzx_act(h @ params["pZXLogStd2W"] + params["pZXLogStd2b"])
+        return mu, log_var
+
+    def decode(self, params, z):
+        """p(x|z) distribution parameters (pre-activation)."""
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = self.act(h @ params[f"dW{i}"] + params[f"db{i}"])
+        return h @ params["pXZW"] + params["pXZb"]
+
+    # ---- supervised layer SPI ----
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        h = self._encoder_hidden(params, x)
+        mu = get_activation(self.pzx_activation)(h @ params["pZXMeanW"] + params["pZXMeanb"])
+        return mu, state
+
+    # ---- pretrain: -ELBO ----
+    def elbo_per_example(self, params, x, rng):
+        """negative ELBO per example: KL(q(z|x) || N(0,I)) + E_q[-log p(x|z)],
+        expectation over ``num_samples`` reparameterized draws (reference
+        computeGradientAndScore ELBO loop)."""
+        mu, log_var = self.encode(params, x)
+        kl = -0.5 * jnp.sum(1 + log_var - mu ** 2 - jnp.exp(log_var), axis=-1)
+        recon = 0.0
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * log_var) * eps
+            pre = self.decode(params, z)
+            recon = recon + self.reconstruction_distribution.neg_log_prob(x, pre)
+        return kl + recon / self.num_samples
+
+    def pretrain_loss(self, params, x, rng):
+        return jnp.mean(self.elbo_per_example(params, x, rng))
+
+    # ---- user-facing generative APIs (reference :reconstructionProbability,
+    #      :generateAtMeanGivenZ, :generateRandomGivenZ) ----
+    def reconstruction_log_probability(self, params, x, num_samples: int = 5, rng=None):
+        """Importance-sampling estimate of log p(x) (reference
+        reconstructionLogProbability): log mean_s exp(log p(x|z_s) + log p(z_s)
+        - log q(z_s|x))."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        mu, log_var = self.encode(params, x)
+        log_ws = []
+        for s in range(num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * log_var) * eps
+            pre = self.decode(params, z)
+            log_p_xz = -self.reconstruction_distribution.neg_log_prob(x, pre)
+            log_p_z = -0.5 * jnp.sum(jnp.log(2 * jnp.pi) + z ** 2, axis=-1)
+            log_q = -0.5 * jnp.sum(jnp.log(2 * jnp.pi) + log_var
+                                   + eps ** 2, axis=-1)
+            log_ws.append(log_p_xz + log_p_z - log_q)
+        log_w = jnp.stack(log_ws)                      # [S, B]
+        return jax.nn.logsumexp(log_w, axis=0) - jnp.log(float(num_samples))
+
+    def generate_at_mean_given_z(self, params, z):
+        return self.reconstruction_distribution.generate_at_mean(self.decode(params, z))
+
+    def generate_random_given_z(self, params, z, rng):
+        return self.reconstruction_distribution.generate_random(rng, self.decode(params, z))
+
+
+# --------------------------------------------------------------------------
+# RBM layer
+# --------------------------------------------------------------------------
+
+@register
+@dataclass
+class RBM(LayerConf):
+    """Restricted Boltzmann machine (reference nn/conf/layers/RBM.java +
+    nn/layers/feedforward/rbm/RBM.java). Pretrained with CD-k; as a
+    feed-forward layer it is propUp: act(x@W + b) (reference RBM.activate).
+
+    CD-k on TPU: expressed as the free-energy surrogate
+    ``mean F(v_data) - mean F(stop_gradient(v_model))`` whose jax.grad equals
+    the CD-k parameter update — one jitted program, no hand-written
+    positive/negative phase gradients.
+    """
+    n_in: Optional[int] = None
+    n_out: int = 0
+    hidden_unit: str = "binary"       # binary | rectified (reference HiddenUnit)
+    visible_unit: str = "binary"      # binary | gaussian  (reference VisibleUnit)
+    k: int = 1                        # CD-k Gibbs steps
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W", "b", "vb")
+
+    def output_type(self, itype):
+        return InputTypeFeedForward(self.n_out)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        self.n_in = n_in
+        W = self._winit(rng, (n_in, self.n_out), n_in, self.n_out, dtype)
+        return {"W": W, "b": self._binit((self.n_out,), dtype),
+                "vb": self._binit((n_in,), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = maybe_dropout(x, self.dropout, rng, train)
+        act = self.activation or "sigmoid"
+        return get_activation(act)(x @ params["W"] + params["b"]), state
+
+    # ---- CD-k machinery ----
+    def free_energy(self, params, v):
+        """F(v) = -v.vb - sum G(v@W + b), where G is the hidden-unit log
+        partition term: softplus for binary hiddens (dG/dpre = sigmoid =
+        E[h|v]), 0.5*relu(pre)^2 for rectified hiddens (dG/dpre = relu(pre),
+        the NReLU mean-field expectation of Nair & Hinton 2010 — so the CD
+        statistics match what the Gibbs chain samples). Gaussian visible
+        replaces the linear visible term with 0.5||v - vb||^2."""
+        pre_h = v @ params["W"] + params["b"]
+        if self.hidden_unit == "rectified":
+            hidden_term = jnp.sum(0.5 * jnp.maximum(pre_h, 0.0) ** 2, axis=-1)
+        else:
+            hidden_term = jnp.sum(jax.nn.softplus(pre_h), axis=-1)
+        if self.visible_unit == "gaussian":
+            vis_term = 0.5 * jnp.sum((v - params["vb"]) ** 2, axis=-1)
+            return vis_term - hidden_term
+        return -(v @ params["vb"]) - hidden_term
+
+    def _sample_h(self, params, v, rng):
+        pre = v @ params["W"] + params["b"]
+        if self.hidden_unit == "rectified":
+            # NReLU sampling: max(0, pre + N(0, sigmoid(pre))) (reference
+            # RBM.java RectifiedLinear hidden sampling)
+            noise = jax.random.normal(rng, pre.shape, pre.dtype)
+            return jnp.maximum(0.0, pre + noise * jnp.sqrt(jax.nn.sigmoid(pre)))
+        p = jax.nn.sigmoid(pre)
+        return jax.random.bernoulli(rng, p).astype(v.dtype)
+
+    def _sample_v(self, params, h, rng):
+        pre = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "gaussian":
+            return pre + jax.random.normal(rng, pre.shape, pre.dtype)
+        p = jax.nn.sigmoid(pre)
+        return jax.random.bernoulli(rng, p).astype(h.dtype)
+
+    def gibbs_chain(self, params, v0, rng, k: Optional[int] = None):
+        """k alternating Gibbs steps v -> h -> v' (reference RBM.gibbhVh)."""
+        v = v0
+        for step in range(k or self.k):
+            r1 = jax.random.fold_in(rng, 2 * step)
+            r2 = jax.random.fold_in(rng, 2 * step + 1)
+            h = self._sample_h(params, v, r1)
+            v = self._sample_v(params, h, r2)
+        return v
+
+    def pretrain_loss(self, params, x, rng):
+        v_model = jax.lax.stop_gradient(self.gibbs_chain(params, x, rng))
+        return jnp.mean(self.free_energy(params, x)) - \
+            jnp.mean(self.free_energy(params, v_model))
+
+    def reconstruct(self, params, x):
+        """Deterministic one-step reconstruction (mean-field v->h->v)."""
+        h = jax.nn.sigmoid(x @ params["W"] + params["b"])
+        pre_v = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "gaussian":
+            return pre_v
+        return jax.nn.sigmoid(pre_v)

@@ -9,6 +9,7 @@ copy from any StatsStorage with auto-refresh.
 """
 from __future__ import annotations
 
+import html
 import http.server
 import json
 import math
@@ -129,7 +130,8 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
     static = storage.get_static_info(session_id, worker_id) or {}
     updates = storage.get_updates(session_id, worker_id)
 
-    rows = "".join(f"<tr><th>{k}</th><td>{v}</td></tr>"
+    rows = "".join(f"<tr><th>{html.escape(str(k))}</th>"
+                   f"<td>{html.escape(str(v))}</td></tr>"
                    for k, v in static.items() if k != "param_names")
     static_table = f"<table>{rows}</table>" if rows else "<p class='meta'>–</p>"
 
@@ -172,7 +174,8 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
     refresh = (f'<meta http-equiv="refresh" content="{auto_refresh_sec}">'
                if auto_refresh_sec else "")
     return _PAGE.format(
-        refresh=refresh, session=session_id or "–", worker=worker_id or "–",
+        refresh=refresh, session=html.escape(session_id or "–", quote=True),
+        worker=html.escape(worker_id or "–", quote=True),
         static_table=static_table,
         score_chart=_svg_line_chart([("score", score_pts)]),
         speed_chart=_svg_line_chart([("it/s", speed_pts)]),

@@ -90,6 +90,7 @@ class StatsListener(TrainingListener):
         self._static_posted = False
         self._stats_fn = None
         self._act_fn = None
+        self._upd_fn = None
         self._prev_snapshot = None
         self._prev_snapshot_iter = None
         self._last_report_time = None
@@ -140,18 +141,24 @@ class StatsListener(TrainingListener):
             return None
         iters = max(iteration - self._prev_snapshot_iter, 1)
 
-        def upd(p, prev):
-            out = {}
-            named_now = _named_leaves(p)
-            named_prev = dict(_named_leaves(prev))
-            for name, leaf in named_now:
-                d = (leaf.astype(jnp.float32) - named_prev[name].astype(jnp.float32))
-                d = d.reshape(-1) / iters
-                out[name] = {"meanmag": jnp.mean(jnp.abs(d)),
-                             "mean": jnp.mean(d), "stdev": jnp.std(d)}
-            return out
+        if self._upd_fn is None:
+            # Built once and cached; ``iters`` is a traced argument so the
+            # compiled program is reused across reports (a fresh closure per
+            # report would force an XLA recompile every iteration).
+            def upd(p, prev, n_iters):
+                out = {}
+                named_now = _named_leaves(p)
+                named_prev = dict(_named_leaves(prev))
+                for name, leaf in named_now:
+                    d = (leaf.astype(jnp.float32) - named_prev[name].astype(jnp.float32))
+                    d = d.reshape(-1) / n_iters
+                    out[name] = {"meanmag": jnp.mean(jnp.abs(d)),
+                                 "mean": jnp.mean(d), "stdev": jnp.std(d)}
+                return out
+            self._upd_fn = jax.jit(upd)
 
-        host = jax.device_get(jax.jit(upd)(params, self._prev_snapshot))
+        host = jax.device_get(self._upd_fn(params, self._prev_snapshot,
+                                           jnp.float32(iters)))
         return {n: {k: float(v) for k, v in d.items()} for n, d in host.items()}
 
     def _snapshot(self, params):

@@ -20,26 +20,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _flat_loss_fn(net, x, y, labels_mask=None, features_mask=None):
-    """Return loss(flat_params) with the net's structure closed over."""
-    shapes = []
-    for layer, p in zip(net.layers, net.params):
-        for name in layer.param_order:
-            if name in p:
-                shapes.append((name, p[name].shape, p[name].dtype))
+def _named_flat(p0, names):
+    """(flat0, unflatten, size) for the ``names`` entries of one param dict —
+    the single source of the flatten/unflatten layout used by every check."""
+    shapes = [(name, p0[name].shape, p0[name].dtype) for name in names if name in p0]
+    size = sum(int(np.prod(s)) if s else 1 for _, s, _ in shapes)
 
     def unflatten(flat):
-        params, off, li = [], 0, 0
-        it = iter(shapes)
-        for layer, p in zip(net.layers, net.params):
-            np_ = dict(p)
-            for name in layer.param_order:
-                if name in p:
-                    _, shape, dtype = next(it)
-                    n = int(np.prod(shape)) if shape else 1
-                    np_[name] = flat[off:off + n].reshape(shape).astype(dtype)
-                    off += n
-            params.append(np_)
+        out, off = dict(p0), 0
+        for name, shape, dtype in shapes:
+            n = int(np.prod(shape)) if shape else 1
+            out[name] = flat[off:off + n].reshape(shape).astype(dtype)
+            off += n
+        return out
+
+    flat0 = (np.concatenate([np.asarray(p0[name]).ravel() for name, _, _ in shapes])
+             .astype(np.float64) if shapes else np.zeros((0,), np.float64))
+    return flat0, unflatten, size
+
+
+def _flat_loss_fn(net, x, y, labels_mask=None, features_mask=None):
+    """Return loss(flat_params) with the net's structure closed over."""
+    per_layer = [_named_flat(p, layer.param_order)
+                 for layer, p in zip(net.layers, net.params)]
+
+    def unflatten(flat):
+        params, off = [], 0
+        for _, unf, size in per_layer:
+            params.append(unf(flat[off:off + size]))
+            off += size
         return tuple(params)
 
     def loss(flat):
@@ -74,6 +83,79 @@ def _perturbed_losses(loss, flat0: np.ndarray, idxs: np.ndarray,
     return out
 
 
+def _check_flat(loss, flat0: np.ndarray, *, epsilon: float, max_rel_error: float,
+                min_abs_error: float, subset: Optional[int], seed: int,
+                print_results: bool) -> bool:
+    """Shared core: central differences of ``loss`` at ``flat0`` vs jax.grad."""
+    analytic = np.asarray(jax.grad(loss)(jnp.asarray(flat0)))
+    n = flat0.shape[0]
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.random.default_rng(seed).choice(n, size=subset, replace=False)
+    k = len(idxs)
+    vals = _perturbed_losses(loss, flat0, np.asarray(idxs), epsilon)
+    numeric_all = (vals[:k] - vals[k:]) / (2 * epsilon)
+    max_rel_seen, fails = 0.0, 0
+    for j, i in enumerate(idxs):
+        numeric = float(numeric_all[j])
+        a = float(analytic[i])
+        denom = abs(a) + abs(numeric)
+        rel = abs(a - numeric) / denom if denom > 0 else 0.0
+        if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+            fails += 1
+            if print_results:
+                print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} rel={rel:.3g}")
+        max_rel_seen = max(max_rel_seen, rel)
+    if print_results:
+        print(f"checked {len(idxs)}/{n} params, max rel error {max_rel_seen:.3g}, "
+              f"{fails} failures")
+    return fails == 0
+
+
+def check_pretrain_gradients(net, layer_idx: int, x, *, epsilon: float = 1e-6,
+                             max_rel_error: float = 1e-3, min_abs_error: float = 1e-8,
+                             subset: Optional[int] = None, seed: int = 0,
+                             rng_seed: int = 12345,
+                             print_results: bool = False) -> bool:
+    """Gradient-check a layer's unsupervised ``pretrain_loss`` (reference
+    GradientCheckUtil.java:413 checkGradientsPretrainLayer; VaeGradientCheckTests).
+
+    The sampling rng is FIXED across all 2N evaluations, so REPARAMETERIZED
+    stochastic objectives (VAE ELBO, denoising AE) are deterministic functions
+    of params and central differences are exact. Objectives that deliberately
+    stop-gradient a params-dependent sample (RBM CD-k: v_model is smooth in
+    params under fixed rng, but the CD update drops dF/dv_model by design)
+    are NOT gradient-checkable this way and are rejected.
+    """
+    if hasattr(net.layers[layer_idx], "gibbs_chain"):
+        raise ValueError(
+            "RBM CD-k is not the gradient of its surrogate loss through the "
+            "Gibbs chain (stop_gradient is the point); central differences "
+            "would disagree by construction. Test CD via its update identity "
+            "instead (see test_rbm_free_energy_surrogate_matches_cd_update).")
+    if jnp.dtype(net.conf.dtype) != jnp.float64:
+        raise ValueError("Gradient checks require dtype='float64'")
+    layer = net.layers[layer_idx]
+    x = jnp.asarray(x, jnp.float64)
+    feed = x
+    if layer_idx > 0:
+        acts, _ = net.apply_fn(net.params, net.state, x, train=False,
+                               to_layer=layer_idx - 1)
+        feed = acts[-1]
+    pre = net.conf.preprocessor(layer_idx)
+    if pre is not None:
+        feed = pre.apply(feed)
+    rng = jax.random.PRNGKey(rng_seed)
+    flat0, unflatten, _ = _named_flat(net.params[layer_idx], layer.param_order)
+
+    def loss(flat):
+        return layer.pretrain_loss(unflatten(flat), feed, rng)
+
+    return _check_flat(loss, flat0, epsilon=epsilon, max_rel_error=max_rel_error,
+                       min_abs_error=min_abs_error, subset=subset, seed=seed,
+                       print_results=print_results)
+
+
 def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 1e-3,
                     min_abs_error: float = 1e-8, labels_mask=None, features_mask=None,
                     print_results: bool = False, subset: Optional[int] = None,
@@ -102,31 +184,7 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 
     # (vmap-batched, which does not fuse) matches the analytic gradient to
     # full f64 precision.
     loss = _flat_loss_fn(net, x, y, labels_mask, features_mask)
-    flat0 = jnp.asarray(net.params_flat(), jnp.float64)
-    analytic = np.asarray(jax.grad(_flat_loss_fn(net, x, y, labels_mask,
-                                                 features_mask))(flat0))
-    n = flat0.shape[0]
-    idxs = np.arange(n)
-    if subset is not None and subset < n:
-        idxs = np.random.default_rng(seed).choice(n, size=subset, replace=False)
-
-    flat0_np = np.asarray(flat0)
-    k = len(idxs)
-    vals = _perturbed_losses(loss, flat0_np, np.asarray(idxs), epsilon)
-    numeric_all = (vals[:k] - vals[k:]) / (2 * epsilon)
-
-    max_rel_seen, fails = 0.0, 0
-    for j, i in enumerate(idxs):
-        numeric = float(numeric_all[j])
-        a = float(analytic[i])
-        denom = abs(a) + abs(numeric)
-        rel = abs(a - numeric) / denom if denom > 0 else 0.0
-        if rel > max_rel_error and abs(a - numeric) > min_abs_error:
-            fails += 1
-            if print_results:
-                print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} rel={rel:.3g}")
-        max_rel_seen = max(max_rel_seen, rel)
-    if print_results:
-        print(f"checked {len(idxs)}/{n} params, max rel error {max_rel_seen:.3g}, "
-              f"{fails} failures")
-    return fails == 0
+    flat0 = np.asarray(net.params_flat(), np.float64)
+    return _check_flat(loss, flat0, epsilon=epsilon, max_rel_error=max_rel_error,
+                       min_abs_error=min_abs_error, subset=subset, seed=seed,
+                       print_results=print_results)

@@ -1,0 +1,177 @@
+"""VAE + RBM tests (mirrors reference VaeGradientCheckTests + TestVAE +
+RBMTests): pretrain ELBO gradient checks across reconstruction distributions,
+supervised-path gradient checks, generative APIs, RBM CD-k pretraining."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (BernoulliReconstructionDistribution,
+                                          CompositeReconstructionDistribution,
+                                          DenseLayer,
+                                          ExponentialReconstructionDistribution,
+                                          GaussianReconstructionDistribution,
+                                          LossFunctionWrapper, OutputLayer,
+                                          RBM, VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.serde import from_json, to_json
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.util.gradcheck import (check_gradients,
+                                               check_pretrain_gradients)
+
+R = np.random.default_rng(7)
+
+
+def _vae_net(dist, n_in=6, latent=3, act="tanh", num_samples=1):
+    conf = NeuralNetConfiguration(seed=12345, updater=Sgd(0.05), dtype="float64") \
+        .list(VariationalAutoencoder(
+            n_in=n_in, n_out=latent, encoder_layer_sizes=(7,),
+            decoder_layer_sizes=(7,), activation=act,
+            reconstruction_distribution=dist, num_samples=num_samples),
+        ).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(dist, n=8, d=6):
+    if isinstance(dist, BernoulliReconstructionDistribution):
+        return (R.random((n, d)) > 0.5).astype(float)
+    if isinstance(dist, ExponentialReconstructionDistribution):
+        return R.exponential(1.0, size=(n, d))
+    return R.normal(size=(n, d))
+
+
+@pytest.mark.parametrize("dist", [
+    GaussianReconstructionDistribution(),
+    GaussianReconstructionDistribution(activation="tanh"),
+    BernoulliReconstructionDistribution(),
+    ExponentialReconstructionDistribution(),
+    LossFunctionWrapper(loss="mse"),
+], ids=["gaussian", "gaussian-tanh", "bernoulli", "exponential", "losswrapper"])
+def test_vae_pretrain_gradients(dist):
+    net = _vae_net(dist)
+    x = _data(dist)
+    assert check_pretrain_gradients(net, 0, x, print_results=True)
+
+
+def test_vae_pretrain_gradients_multisample():
+    net = _vae_net(GaussianReconstructionDistribution(), num_samples=3)
+    x = _data(GaussianReconstructionDistribution())
+    assert check_pretrain_gradients(net, 0, x, print_results=True)
+
+
+def test_vae_pretrain_gradients_composite():
+    # columns 0-2 gaussian, 3-5 bernoulli (reference
+    # CompositeReconstructionDistribution usage in VaeGradientCheckTests)
+    dist = CompositeReconstructionDistribution(parts=[
+        [3, GaussianReconstructionDistribution()],
+        [3, BernoulliReconstructionDistribution()]])
+    net = _vae_net(dist)
+    x = np.concatenate([R.normal(size=(8, 3)),
+                        (R.random((8, 3)) > 0.5).astype(float)], axis=1)
+    assert check_pretrain_gradients(net, 0, x, print_results=True)
+
+
+def test_vae_supervised_gradients():
+    """VAE as a hidden layer of a classifier (reference VaeGradientCheckTests
+    testVaeAsMLP): forward = mean(q(z|x)); decoder params get zero gradient."""
+    conf = NeuralNetConfiguration(seed=12345, updater=Sgd(0.05), dtype="float64") \
+        .list(VariationalAutoencoder(n_in=4, n_out=3, encoder_layer_sizes=(6,),
+                                     decoder_layer_sizes=(6,), activation="tanh"),
+              OutputLayer(n_out=3, activation="softmax", loss="mcxent")).build()
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(10, 4))
+    y = np.eye(3)[R.integers(0, 3, 10)]
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_vae_pretrain_improves_elbo_and_generates():
+    dist = BernoulliReconstructionDistribution()
+    net = _vae_net(dist, n_in=8, latent=2)
+    x = (R.random((64, 8)) > 0.6).astype(float)
+    layer = net.layers[0]
+    rng = jax.random.PRNGKey(0)
+    before = float(layer.pretrain_loss(net.params[0], jnp.asarray(x), rng))
+    it = ListDataSetIterator(features=x, labels=x, batch_size=16)
+    net.pretrain(it, epochs=30)
+    after = float(layer.pretrain_loss(net.params[0], jnp.asarray(x), rng))
+    assert after < before
+    # generative APIs
+    z = jnp.asarray(R.normal(size=(5, 2)))
+    mean_x = layer.generate_at_mean_given_z(net.params[0], z)
+    assert mean_x.shape == (5, 8)
+    assert np.all(np.asarray(mean_x) >= 0) and np.all(np.asarray(mean_x) <= 1)
+    rand_x = layer.generate_random_given_z(net.params[0], z, jax.random.PRNGKey(1))
+    assert set(np.unique(np.asarray(rand_x))) <= {0.0, 1.0}
+    logp = layer.reconstruction_log_probability(net.params[0], jnp.asarray(x[:4]),
+                                                num_samples=10)
+    assert logp.shape == (4,)
+    assert np.all(np.isfinite(np.asarray(logp)))
+
+
+def test_vae_config_roundtrip():
+    dist = CompositeReconstructionDistribution(parts=[
+        [2, GaussianReconstructionDistribution(activation="tanh")],
+        [3, BernoulliReconstructionDistribution()]])
+    layer = VariationalAutoencoder(n_in=5, n_out=2, encoder_layer_sizes=(4, 3),
+                                   decoder_layer_sizes=(3, 4),
+                                   reconstruction_distribution=dist,
+                                   pzx_activation="tanh", num_samples=2)
+    back = from_json(to_json(layer))
+    assert back == layer
+    assert back.param_order == layer.param_order
+
+
+def test_rbm_supervised_gradients():
+    """RBM as feed-forward layer: propUp is just act(xW+b) (reference
+    RBM.activate)."""
+    conf = NeuralNetConfiguration(seed=12345, updater=Sgd(0.05), dtype="float64") \
+        .list(RBM(n_in=4, n_out=5),
+              OutputLayer(n_out=3, activation="softmax", loss="mcxent")).build()
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(10, 4))
+    y = np.eye(3)[R.integers(0, 3, 10)]
+    assert check_gradients(net, x, y, print_results=True)
+
+
+@pytest.mark.parametrize("visible,hidden", [("binary", "binary"),
+                                            ("gaussian", "rectified")])
+def test_rbm_cd_pretrain_reduces_reconstruction_error(visible, hidden):
+    conf = NeuralNetConfiguration(seed=12345, updater=Sgd(0.05), dtype="float64") \
+        .list(RBM(n_in=6, n_out=12, visible_unit=visible, hidden_unit=hidden, k=1),
+        ).build()
+    net = MultiLayerNetwork(conf).init()
+    # two prototype patterns + noise
+    protos = np.array([[1, 1, 1, 0, 0, 0], [0, 0, 0, 1, 1, 1]], dtype=float)
+    x = protos[R.integers(0, 2, 128)]
+    if visible == "binary":
+        flip = R.random(x.shape) < 0.05
+        x = np.where(flip, 1 - x, x)
+    else:
+        x = x + 0.1 * R.normal(size=x.shape)
+    layer = net.layers[0]
+
+    def recon_err(params):
+        r = layer.reconstruct(params, jnp.asarray(x))
+        return float(jnp.mean((r - x) ** 2))
+
+    before = recon_err(net.params[0])
+    it = ListDataSetIterator(features=x, labels=x, batch_size=32)
+    net.pretrain(it, epochs=20)
+    after = recon_err(net.params[0])
+    assert after < before
+
+
+def test_rbm_free_energy_surrogate_matches_cd_update():
+    """grad of the surrogate loss w.r.t. vb must be exactly -(mean v_data -
+    mean v_model) — the textbook CD visible-bias update."""
+    layer = RBM(n_in=4, n_out=3, k=1)
+    rng = jax.random.PRNGKey(3)
+    params = {"W": jnp.asarray(R.normal(size=(4, 3)) * 0.1),
+              "b": jnp.zeros(3), "vb": jnp.zeros(4)}
+    v0 = jnp.asarray((R.random((16, 4)) > 0.5).astype(float))
+    grads = jax.grad(lambda p: layer.pretrain_loss(p, v0, rng))(params)
+    v_model = layer.gibbs_chain(params, v0, rng)
+    expected_vb = -(jnp.mean(v0, axis=0) - jnp.mean(v_model, axis=0))
+    np.testing.assert_allclose(np.asarray(grads["vb"]),
+                               np.asarray(expected_vb), atol=1e-10)

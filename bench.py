@@ -1,23 +1,34 @@
-"""Benchmark: ResNet-50 training throughput (img/sec/chip) — BASELINE #2.
+"""Benchmarks for the BASELINE.md configs.
 
-Compares this framework's ResNet-50 (zoo model + jitted solver step) against
-an independent reference implementation (flax.linen ResNet-50 + optax),
-both on the same device with the same batch/dtype. The BASELINE.md target is
->= 0.70 x reference; ``vs_baseline`` reports ours/reference.
+Headline (the ONE JSON line printed to stdout, consumed by the driver):
+ResNet-50 ImageNet-shape training throughput, img/sec/chip, f32 224x224
+(BASELINE #2), vs an independent flax.linen+optax ResNet-50 on the same
+device/batch/dtype — target >= 0.70x (vs_baseline = ours/reference).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/sec", "vs_baseline": N}
+The same line carries an ``extras`` dict with the remaining BASELINE rows:
+  - resnet50_bf16_img_per_sec      ResNet-50, bfloat16 params+data
+  - lstm_train_tokens_per_sec      GravesLSTM char-RNN (BASELINE #3)
+  - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE #4)
+  - dp_scaling_efficiency_8dev     ParallelWrapper on the 8-device virtual CPU
+                                   mesh (BASELINE #5; chips unavailable, so
+                                   this reports mesh-overhead efficiency, not
+                                   ICI bandwidth)
+  - threshold_encode_ms_25m        threshold encode+decode on a 25M-param
+                                   flat gradient (DCN codec overhead)
+
+Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1.
 """
 import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BATCH = int(os.environ.get("BENCH_BATCH", "64"))
-IMG = int(os.environ.get("BENCH_IMG", "128"))
+IMG = int(os.environ.get("BENCH_IMG", "224"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 WARMUP = 3
 
@@ -36,17 +47,20 @@ def _time_steps(step_fn, args, steps):
     return (time.perf_counter() - t0) / steps
 
 
-def bench_ours():
+def bench_ours(dtype="float32", batch=None, img=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.zoo import resnet50
     from deeplearning4j_tpu.optimize.updaters import Nesterovs
 
-    net = resnet50(n_classes=1000, height=IMG, width=IMG, channels=3,
-                   updater=Nesterovs(0.1, momentum=0.9)).init()
+    batch = batch or BATCH
+    img = img or IMG
+    net = resnet50(n_classes=1000, height=img, width=img, channels=3,
+                   updater=Nesterovs(0.1, momentum=0.9), dtype=dtype).init()
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(BATCH, IMG, IMG, 3)), jnp.float32)
-    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)])
+    jdt = jnp.dtype(dtype)
+    x = jnp.asarray(rng.normal(size=(batch, img, img, 3)), jdt)
+    y = jnp.asarray(np.eye(1000)[rng.integers(0, 1000, batch)], jdt)
 
     @functools.partial(jax.jit, donate_argnums=(0, 2))
     def step(params, state, opt_state, it, key):
@@ -59,7 +73,7 @@ def bench_ours():
     dt = _time_steps(step, [net.params, net.state, net.opt_state,
                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
                      STEPS)
-    return BATCH / dt
+    return batch / dt
 
 
 def bench_reference():
@@ -132,6 +146,144 @@ def bench_reference():
     return BATCH / dt
 
 
+def bench_lstm():
+    """GravesLSTM char-RNN training tokens/sec (BASELINE #3 shape: one-hot
+    vocab ~87, seq 64, hidden 512, 2 layers)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import RmsProp
+
+    V, T, B, H = 87, 64, 32, 512
+    conf = (NeuralNetConfiguration(seed=1, updater=RmsProp(1e-3), dtype="float32")
+            .list(GravesLSTM(n_out=H, activation="tanh"),
+                  GravesLSTM(n_out=H, activation="tanh"),
+                  RnnOutputLayer(n_out=V, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(V, T)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, T))
+    x = jnp.asarray(np.eye(V, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(params, state, opt_state, it, key):
+        def lf(p):
+            return net.loss_fn(p, state, x, y, train=True, rng=key)
+        (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+        return new_params, new_state, new_opt, it + 1, key
+
+    dt = _time_steps(step, [net.params, net.state, net.opt_state,
+                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
+                     STEPS)
+    return B * T / dt
+
+
+def bench_word2vec():
+    """SkipGram negative-sampling jitted step, words(centers)/sec
+    (BASELINE #4: large embedding table)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.sequence_vectors import make_neg_sampling_step
+
+    V, D, B, NEG = 100_000, 128, 4096, 5
+    rng = np.random.default_rng(0)
+    syn0 = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32) * 0.01)
+    syn1 = jnp.zeros((V, D), jnp.float32)
+    step = make_neg_sampling_step(lr=0.025, negative=NEG)
+    centers = jnp.asarray(rng.integers(0, V, (B,)))
+    contexts = jnp.asarray(rng.integers(0, V, (B,)))
+    key = jax.random.PRNGKey(0)
+
+    def wrapped(syn0, syn1, key):
+        k1, k2 = jax.random.split(key)
+        s0, s1 = step(syn0, syn1, centers, contexts, k1)
+        return s0, s1, k2
+
+    dt = _time_steps(wrapped, [syn0, syn1, key], STEPS)
+    return B / dt
+
+
+def bench_threshold_encode():
+    """Encode+decode ms on a 25M-element flat gradient (ResNet-50 scale) —
+    the DCN compression overhead per step (VERDICT r1 item 5)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.compression import threshold_roundtrip
+
+    n = 25_000_000
+    g = jnp.asarray(np.random.default_rng(0).normal(size=(n,)).astype(np.float32))
+
+    def step(res):
+        # update is still computed inside the jitted roundtrip (it is a
+        # returned output); only new_res feeds the next iteration
+        update, new_res, _ = threshold_roundtrip(res, threshold=1e-3,
+                                                 capacity=n // 100)
+        return (new_res,)
+
+    dt = _time_steps(step, [g], max(5, STEPS // 2))
+    return dt * 1e3
+
+
+def bench_dp_scaling():
+    """ParallelWrapper scaling efficiency on the 8-device VIRTUAL CPU mesh
+    (BASELINE #5 — real chips unavailable; measures mesh overhead only).
+    Runs in a subprocess so the CPU platform doesn't poison this process."""
+    code = r"""
+import json, os, time, functools
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+
+def run(workers, batch):
+    conf = (NeuralNetConfiguration(seed=1, updater=Sgd(0.1), dtype="float32")
+            .list(DenseLayer(n_in=512, n_out=2048, activation="relu"),
+                  DenseLayer(n_out=2048, activation="relu"),
+                  OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch * 8, 512)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch * 8)]
+    it = ListDataSetIterator(features=x, labels=y, batch_size=batch * workers)
+    pw = ParallelWrapper(net, workers=workers)
+    pw.fit(it, epochs=1)     # compile + warm
+    it.reset()
+    t0 = time.perf_counter()
+    pw.fit(it, epochs=3)
+    dt = time.perf_counter() - t0
+    n_ex = 3 * batch * 8
+    return n_ex / dt
+
+one = run(1, 256)
+eight = run(8, 256)
+print(json.dumps({"x1": one, "x8": eight, "eff": eight / (8 * one)}))
+"""
+    env = dict(os.environ)
+    # env must be set BEFORE the interpreter starts (sitecustomize pre-imports
+    # jax and latches the platform)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=900, env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(f"dp-scaling subprocess failed (rc={out.returncode}): "
+                           f"{out.stderr.strip()[-500:]}")
+    return json.loads(lines[-1])
+
+
 def main():
     ours = bench_ours()
     try:
@@ -140,11 +292,30 @@ def main():
         print(f"reference bench failed: {e}", file=sys.stderr)
         ref = None
     ratio = (ours / ref) if ref else None
+
+    extras = {}
+    if os.environ.get("BENCH_SKIP_EXTRAS", "0") != "1":
+        for name, fn in [
+            ("resnet50_bf16_img_per_sec", lambda: bench_ours(dtype="bfloat16")),
+            ("lstm_train_tokens_per_sec", bench_lstm),
+            ("word2vec_words_per_sec", bench_word2vec),
+            ("threshold_encode_ms_25m", bench_threshold_encode),
+            ("dp_scaling_efficiency_8dev", bench_dp_scaling),
+        ]:
+            try:
+                v = fn()
+                extras[name] = round(v, 3) if isinstance(v, float) else v
+            except Exception as e:
+                print(f"extra bench {name} failed: {e}", file=sys.stderr)
+                extras[name] = None
+
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(ours, 2),
         "unit": "img/sec",
         "vs_baseline": round(ratio, 3) if ratio else None,
+        "config": {"batch": BATCH, "img": IMG, "dtype": "float32"},
+        "extras": extras,
     }))
 
 

@@ -1,0 +1,20 @@
+from .dataset import (AsyncDataSetIterator, DataSet, DataSetIterator,
+                      ListDataSetIterator)
+from .fetchers import (Cifar10DataSetIterator, CurvesDataSetIterator,
+                       IrisDataSetIterator, load_cifar10, load_curves,
+                       load_iris)
+from .iterators import (EarlyTerminationDataSetIterator,
+                        ExistingDataSetIterator, IteratorDataSetIterator,
+                        ListMultiDataSetIterator, MultiDataSet,
+                        MultipleEpochsIterator, SamplingDataSetIterator)
+from .mnist import MnistDataSetIterator, load_mnist
+
+__all__ = [
+    "AsyncDataSetIterator", "Cifar10DataSetIterator", "CurvesDataSetIterator",
+    "DataSet", "DataSetIterator", "EarlyTerminationDataSetIterator",
+    "ExistingDataSetIterator", "IrisDataSetIterator",
+    "IteratorDataSetIterator", "ListDataSetIterator",
+    "ListMultiDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
+    "MultipleEpochsIterator", "SamplingDataSetIterator", "load_cifar10",
+    "load_curves", "load_iris", "load_mnist",
+]

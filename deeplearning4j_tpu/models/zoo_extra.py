@@ -1,0 +1,300 @@
+"""Model zoo, part 2: GoogLeNet, InceptionResNetV1, FaceNetNN4Small2,
+TextGenerationLSTM.
+
+Reference: deeplearning4j-zoo zoo/model/{GoogLeNet.java (inception modules
+:125-140, main graph :144-176), InceptionResNetV1.java (stem :113-163,
+reductions :173-216,226-296, output head :81-92),
+FaceNetNN4Small2.java (OpenFace nn4.small2 topology, center-loss output),
+TextGenerationLSTM.java (:76-92)} and
+zoo/model/helper/InceptionResNetHelper.java (inceptionV1ResA :41, ResB :162,
+ResC :262 — residual blocks with ScaleVertex + tanh, the dims mirrored here).
+
+All CNNs are NHWC ComputationGraphs (TPU layout).
+"""
+from __future__ import annotations
+
+from ..nn.conf.config import NeuralNetConfiguration
+from ..nn.graph.graph import ComputationGraph
+from ..nn.graph.vertices import ElementWiseVertex, MergeVertex, ScaleVertex, L2NormalizeVertex
+from ..nn.inputs import InputType
+from ..nn.layers import (ActivationLayer, BatchNormalization,
+                         CenterLossOutputLayer, ConvolutionLayer, DenseLayer,
+                         GlobalPoolingLayer, GravesLSTM,
+                         LocalResponseNormalization, OutputLayer,
+                         RnnOutputLayer, SubsamplingLayer)
+from ..nn.multilayer import MultiLayerNetwork
+from ..optimize.updaters import Adam, Nesterovs, RmsProp
+from .zoo import _base_builder
+
+
+# -------------------------------------------------------------------- GoogLeNet
+def _inception_v1(g, name, inp, cfg):
+    """One GoogLeNet inception module (reference GoogLeNet.java:125-140):
+    cfg = [[c1x1], [c3x3_reduce, c3x3], [c5x5_reduce, c5x5], [pool_proj]]."""
+    g.add_layer(f"{name}-cnn1", ConvolutionLayer(
+        n_out=cfg[0][0], kernel_size=(1, 1), convolution_mode="same",
+        activation="relu", bias_init=0.2), inp)
+    g.add_layer(f"{name}-cnn2", ConvolutionLayer(
+        n_out=cfg[1][0], kernel_size=(1, 1), convolution_mode="same",
+        activation="relu", bias_init=0.2), inp)
+    g.add_layer(f"{name}-cnn3", ConvolutionLayer(
+        n_out=cfg[2][0], kernel_size=(1, 1), convolution_mode="same",
+        activation="relu", bias_init=0.2), inp)
+    g.add_layer(f"{name}-max1", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(1, 1),
+        convolution_mode="same"), inp)
+    g.add_layer(f"{name}-cnn4", ConvolutionLayer(
+        n_out=cfg[1][1], kernel_size=(3, 3), convolution_mode="same",
+        activation="relu", bias_init=0.2), f"{name}-cnn2")
+    g.add_layer(f"{name}-cnn5", ConvolutionLayer(
+        n_out=cfg[2][1], kernel_size=(5, 5), convolution_mode="same",
+        activation="relu", bias_init=0.2), f"{name}-cnn3")
+    g.add_layer(f"{name}-cnn6", ConvolutionLayer(
+        n_out=cfg[3][0], kernel_size=(1, 1), convolution_mode="same",
+        activation="relu", bias_init=0.2), f"{name}-max1")
+    g.add_vertex(f"{name}-depthconcat1", MergeVertex(),
+                 f"{name}-cnn1", f"{name}-cnn4", f"{name}-cnn5", f"{name}-cnn6")
+    return f"{name}-depthconcat1"
+
+
+def googlenet(n_classes: int = 1000, *, height: int = 224, width: int = 224,
+              channels: int = 3, seed: int = 42, updater=None,
+              dtype: str = "float32") -> ComputationGraph:
+    """Reference zoo/model/GoogLeNet.java conf() :144-176."""
+    g = _base_builder(seed, updater or Nesterovs(1e-2, momentum=0.9), dtype,
+                      l2=2e-4)
+    g.add_inputs("input")
+    g.add_layer("cnn1", ConvolutionLayer(n_out=64, kernel_size=(7, 7),
+                                         stride=(2, 2), convolution_mode="same",
+                                         activation="relu", bias_init=0.2), "input")
+    g.add_layer("max1", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                         stride=(2, 2), convolution_mode="same"),
+                "cnn1")
+    g.add_layer("lrn1", LocalResponseNormalization(n=5, alpha=1e-4, beta=0.75),
+                "max1")
+    g.add_layer("cnn2", ConvolutionLayer(n_out=64, kernel_size=(1, 1),
+                                         convolution_mode="same",
+                                         activation="relu", bias_init=0.2), "lrn1")
+    g.add_layer("cnn3", ConvolutionLayer(n_out=192, kernel_size=(3, 3),
+                                         convolution_mode="same",
+                                         activation="relu", bias_init=0.2), "cnn2")
+    g.add_layer("lrn2", LocalResponseNormalization(n=5, alpha=1e-4, beta=0.75),
+                "cnn3")
+    g.add_layer("max2", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                         stride=(2, 2), convolution_mode="same"),
+                "lrn2")
+    x = _inception_v1(g, "3a", "max2", [[64], [96, 128], [16, 32], [32]])
+    x = _inception_v1(g, "3b", x, [[128], [128, 192], [32, 96], [64]])
+    g.add_layer("max3", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                         stride=(2, 2), convolution_mode="same"), x)
+    x = _inception_v1(g, "4a", "max3", [[192], [96, 208], [16, 48], [64]])
+    x = _inception_v1(g, "4b", x, [[160], [112, 224], [24, 64], [64]])
+    x = _inception_v1(g, "4c", x, [[128], [128, 256], [24, 64], [64]])
+    x = _inception_v1(g, "4d", x, [[112], [144, 288], [32, 64], [64]])
+    x = _inception_v1(g, "4e", x, [[256], [160, 320], [32, 128], [128]])
+    g.add_layer("max4", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                         stride=(2, 2), convolution_mode="same"), x)
+    x = _inception_v1(g, "5a", "max4", [[256], [160, 320], [32, 128], [128]])
+    x = _inception_v1(g, "5b", x, [[384], [192, 384], [48, 128], [128]])
+    g.add_layer("avg3", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("fc1", DenseLayer(n_out=1024, activation="relu", dropout=0.4), "avg3")
+    g.add_layer("output", OutputLayer(n_out=n_classes, activation="softmax",
+                                      loss="mcxent", weight_init="xavier"), "fc1")
+    g.set_outputs("output")
+    g.set_input_types(InputType.convolutional(height, width, channels))
+    return ComputationGraph(g.build())
+
+
+# ------------------------------------------------------------ InceptionResNetV1
+def _conv_bn_ir(g, name, inp, n_out, kernel, stride=(1, 1), act="relu"):
+    g.add_layer(f"{name}", ConvolutionLayer(
+        n_out=n_out, kernel_size=kernel, stride=stride,
+        convolution_mode="same"), inp)
+    g.add_layer(f"{name}-bn", BatchNormalization(activation=act, eps=1e-3,
+                                                 decay=0.995), f"{name}")
+    return f"{name}-bn"
+
+
+def _ires_block(g, name, inp, branches, merge_to, scale):
+    """Generic Inception-ResNet residual block (reference
+    InceptionResNetHelper inceptionV1Res{A,B,C}): parallel conv-BN branches,
+    merge, 1x1 (or 3x3) projection back to the trunk width, ScaleVertex,
+    residual add, tanh."""
+    ends = []
+    for bi, chain in enumerate(branches):
+        x = inp
+        for ci, (n_out, kernel) in enumerate(chain):
+            x = _conv_bn_ir(g, f"{name}-b{bi}c{ci}", x, n_out, kernel)
+        ends.append(x)
+    g.add_vertex(f"{name}-merge", MergeVertex(), *ends)
+    proj_out, proj_kernel = merge_to
+    x = _conv_bn_ir(g, f"{name}-proj", f"{name}-merge", proj_out, proj_kernel,
+                    act="identity")
+    g.add_vertex(f"{name}-scale", ScaleVertex(scale_factor=scale), x)
+    g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), f"{name}-scale", inp)
+    g.add_layer(f"{name}", ActivationLayer(activation="tanh"), f"{name}-add")
+    return f"{name}"
+
+
+def inception_resnet_v1(n_classes: int = 1000, *, height: int = 160,
+                        width: int = 160, channels: int = 3,
+                        embedding_size: int = 128, seed: int = 42,
+                        updater=None, dtype: str = "float32",
+                        res_a: int = 5, res_b: int = 10, res_c: int = 5
+                        ) -> ComputationGraph:
+    """Reference zoo/model/InceptionResNetV1.java: FaceNet-style
+    Inception-ResNet with an L2-normalized embedding bottleneck and a
+    center-loss softmax head (:81-92). Block counts (5/10/5) and channel dims
+    follow the reference; pass smaller counts for test-sized instantiations."""
+    g = _base_builder(seed, updater or RmsProp(0.1), dtype)
+    g.add_inputs("input")
+    # stem (:113-163): 32/2, 32, 64, maxpool/2, 80(1x1), 128, 192/2
+    x = _conv_bn_ir(g, "stem-1", "input", 32, (3, 3), (2, 2))
+    x = _conv_bn_ir(g, "stem-2", x, 32, (3, 3))
+    x = _conv_bn_ir(g, "stem-3", x, 64, (3, 3))
+    g.add_layer("stem-pool", SubsamplingLayer(pooling_type="max",
+                                              kernel_size=(3, 3), stride=(2, 2),
+                                              convolution_mode="same"), x)
+    x = _conv_bn_ir(g, "stem-5", "stem-pool", 80, (1, 1))
+    x = _conv_bn_ir(g, "stem-6", x, 128, (3, 3))
+    x = _conv_bn_ir(g, "stem-7", x, 192, (3, 3), (2, 2))
+    # 5 x Inception-ResNet-A (192 trunk, 32-wide branches, scale 0.17)
+    for i in range(res_a):
+        x = _ires_block(g, f"resA{i}", x,
+                        branches=[[(32, (1, 1))],
+                                  [(32, (1, 1)), (32, (3, 3))],
+                                  [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]],
+                        merge_to=(192, (3, 3)), scale=0.17)
+    # reduction-A (:173-216): 192 -> 576
+    ra1 = _conv_bn_ir(g, "reduceA-1", x, 192, (3, 3), (2, 2))
+    ra2 = _conv_bn_ir(g, "reduceA-2a", x, 128, (1, 1))
+    ra2 = _conv_bn_ir(g, "reduceA-2b", ra2, 128, (3, 3))
+    ra2 = _conv_bn_ir(g, "reduceA-2c", ra2, 192, (3, 3), (2, 2))
+    g.add_layer("reduceA-pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), x)
+    g.add_vertex("reduceA", MergeVertex(), ra1, ra2, "reduceA-pool")
+    x = "reduceA"
+    # 10 x Inception-ResNet-B (576 trunk, 128-wide 1x3/3x1 branches, 0.10)
+    for i in range(res_b):
+        x = _ires_block(g, f"resB{i}", x,
+                        branches=[[(128, (1, 1))],
+                                  [(128, (1, 1)), (128, (1, 3)), (128, (3, 1))]],
+                        merge_to=(576, (1, 1)), scale=0.10)
+    # reduction-B (:226-296): 576 -> 1344
+    g.add_layer("reduceB-pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), x)
+    rb2 = _conv_bn_ir(g, "reduceB-2a", x, 256, (1, 1))
+    rb2 = _conv_bn_ir(g, "reduceB-2b", rb2, 256, (3, 3), (2, 2))
+    rb3 = _conv_bn_ir(g, "reduceB-3a", x, 256, (1, 1))
+    rb3 = _conv_bn_ir(g, "reduceB-3b", rb3, 256, (3, 3), (2, 2))
+    rb4 = _conv_bn_ir(g, "reduceB-4a", x, 256, (1, 1))
+    rb4 = _conv_bn_ir(g, "reduceB-4b", rb4, 256, (3, 3))
+    rb4 = _conv_bn_ir(g, "reduceB-4c", rb4, 256, (3, 3), (2, 2))
+    g.add_vertex("reduceB", MergeVertex(), "reduceB-pool", rb2, rb3, rb4)
+    x = "reduceB"
+    # 5 x Inception-ResNet-C (1344 trunk, 192-wide branches, scale 0.20)
+    for i in range(res_c):
+        x = _ires_block(g, f"resC{i}", x,
+                        branches=[[(192, (1, 1))],
+                                  [(192, (1, 1)), (192, (1, 3)), (192, (3, 1))]],
+                        merge_to=(1344, (1, 1)), scale=0.20)
+    g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("bottleneck", DenseLayer(n_out=embedding_size,
+                                         activation="identity"), "avgpool")
+    g.add_vertex("embeddings", L2NormalizeVertex(eps=1e-10), "bottleneck")
+    g.add_layer("outputLayer", CenterLossOutputLayer(
+        n_out=n_classes, activation="softmax", loss="mcxent", alpha=0.9,
+        lambda_=1e-4, weight_init="xavier"), "embeddings")
+    g.set_outputs("outputLayer")
+    g.set_input_types(InputType.convolutional(height, width, channels))
+    return ComputationGraph(g.build())
+
+
+# ------------------------------------------------------------ FaceNetNN4Small2
+def _facenet_inception(g, name, inp, b1, b3r, b3, b5r, b5, pool_proj,
+                       stride=(1, 1)):
+    """OpenFace nn4-style BN-inception module (reference
+    zoo/model/helper/FaceNetHelper.java appendGraph): conv branches each
+    conv->BN->relu; reduction variants (stride 2) drop the 1x1 branch."""
+    ends = []
+    if b1:
+        ends.append(_conv_bn_ir(g, f"{name}-1x1", inp, b1, (1, 1)))
+    x = _conv_bn_ir(g, f"{name}-3x3r", inp, b3r, (1, 1))
+    ends.append(_conv_bn_ir(g, f"{name}-3x3", x, b3, (3, 3), stride))
+    if b5r:
+        x = _conv_bn_ir(g, f"{name}-5x5r", inp, b5r, (1, 1))
+        ends.append(_conv_bn_ir(g, f"{name}-5x5", x, b5, (5, 5), stride))
+    pool_stride = stride if stride != (1, 1) else (1, 1)
+    g.add_layer(f"{name}-pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=pool_stride,
+        convolution_mode="same"), inp)
+    if pool_proj:
+        ends.append(_conv_bn_ir(g, f"{name}-poolproj", f"{name}-pool",
+                                pool_proj, (1, 1)))
+    else:
+        ends.append(f"{name}-pool")
+    g.add_vertex(f"{name}", MergeVertex(), *ends)
+    return f"{name}"
+
+
+def facenet_nn4_small2(n_classes: int = 1000, *, height: int = 96,
+                       width: int = 96, channels: int = 3,
+                       embedding_size: int = 128, seed: int = 42,
+                       updater=None, dtype: str = "float32") -> ComputationGraph:
+    """Reference zoo/model/FaceNetNN4Small2.java: OpenFace nn4.small2 with
+    center-loss embedding training (the zoo's CenterLossOutputLayer user)."""
+    g = _base_builder(seed, updater or Adam(1e-3), dtype)
+    g.add_inputs("input")
+    x = _conv_bn_ir(g, "stem-cnn1", "input", 64, (7, 7), (2, 2))
+    g.add_layer("stem-pool1", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), x)
+    g.add_layer("stem-lrn1", LocalResponseNormalization(n=5, alpha=1e-4,
+                                                        beta=0.75), "stem-pool1")
+    x = _conv_bn_ir(g, "inception-2-cnn1", "stem-lrn1", 64, (1, 1))
+    x = _conv_bn_ir(g, "inception-2-cnn2", x, 192, (3, 3))
+    g.add_layer("inception-2-lrn1", LocalResponseNormalization(
+        n=5, alpha=1e-4, beta=0.75), x)
+    g.add_layer("inception-2-pool1", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode="same"), "inception-2-lrn1")
+    x = _facenet_inception(g, "inception-3a", "inception-2-pool1",
+                           64, 96, 128, 16, 32, 32)
+    x = _facenet_inception(g, "inception-3b", x, 64, 96, 128, 32, 64, 64)
+    x = _facenet_inception(g, "inception-3c", x, 0, 128, 256, 32, 64, 0,
+                           stride=(2, 2))
+    x = _facenet_inception(g, "inception-4a", x, 256, 96, 192, 32, 64, 128)
+    x = _facenet_inception(g, "inception-4e", x, 0, 160, 256, 64, 128, 0,
+                           stride=(2, 2))
+    x = _facenet_inception(g, "inception-5a", x, 256, 96, 384, 0, 0, 96)
+    x = _facenet_inception(g, "inception-5b", x, 256, 96, 384, 0, 0, 96)
+    g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("bottleneck", DenseLayer(n_out=embedding_size,
+                                         activation="identity"), "avgpool")
+    g.add_vertex("embeddings", L2NormalizeVertex(eps=1e-10), "bottleneck")
+    g.add_layer("lossLayer", CenterLossOutputLayer(
+        n_out=n_classes, activation="softmax", loss="mcxent", alpha=0.9,
+        lambda_=1e-4, weight_init="xavier"), "embeddings")
+    g.set_outputs("lossLayer")
+    g.set_input_types(InputType.convolutional(height, width, channels))
+    return ComputationGraph(g.build())
+
+
+# --------------------------------------------------------- TextGenerationLSTM
+def text_generation_lstm(vocab_size: int = 77, *, hidden: int = 256,
+                         max_length: int = 40, tbptt_length: int = 50,
+                         seed: int = 12345, updater=None,
+                         dtype: str = "float32") -> MultiLayerNetwork:
+    """Reference zoo/model/TextGenerationLSTM.java conf() :76-92:
+    GravesLSTM(256) x2 + time-distributed softmax, tBPTT 50."""
+    b = (NeuralNetConfiguration(seed=seed, updater=updater or RmsProp(1e-3),
+                                l2=1e-3, weight_init="xavier", dtype=dtype)
+         .list(GravesLSTM(n_out=hidden, activation="tanh"),
+               GravesLSTM(n_out=hidden, activation="tanh"),
+               RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                              loss="mcxent"))
+         .set_input_type(InputType.recurrent(vocab_size, max_length))
+         .tbptt_length(tbptt_length))
+    return MultiLayerNetwork(b.build())

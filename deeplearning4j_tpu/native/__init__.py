@@ -1,0 +1,108 @@
+"""Native (C++) components, built on demand and loaded via ctypes.
+
+The reference leans on libnd4j (C++/CUDA) for its native ops (SURVEY.md §2.6);
+the TPU build keeps the device path in XLA and provides C++ equivalents only
+where the work is host-side by nature — e.g. the threshold codec a DCN hop
+would run on the host network boundary (reference's thresholdEncode/Decode
+are native ND4J ops, EncodingHandler.java:64-66).
+
+Build strategy: `g++ -O3 -shared -fPIC` into the package's `_build/`
+directory on first use (no pybind11 in the image; ctypes binds the extern-C
+surface). Everything degrades gracefully: `available()` is False when no
+compiler is present and callers fall back to the XLA/numpy path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_HERE, "threshold_codec.cpp")
+    out = os.path.join(_BUILD_DIR, "libthreshold_codec.so")
+    if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", src, "-o", out]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(out)
+    except OSError:
+        return None
+    lib.threshold_encode.restype = ctypes.c_int
+    lib.threshold_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int8)]
+    lib.threshold_decode.restype = None
+    lib.threshold_decode.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int8),
+        ctypes.c_int64, ctypes.c_float, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    return lib
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _build_and_load()
+            _TRIED = True
+    return _LIB
+
+
+def available() -> bool:
+    """True when the native codec compiled and loaded on this host."""
+    return _lib() is not None
+
+
+def native_threshold_encode(residual: np.ndarray, threshold: float,
+                            capacity: int):
+    """C++ threshold encode. Mutates nothing: returns
+    (indices[int32 capacity], signs[int8 capacity], count, new_residual).
+    Semantics identical to ops.compression.threshold_encode."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native threshold codec unavailable (no g++?); "
+                           "use ops.compression.threshold_encode instead")
+    res = np.ascontiguousarray(residual, np.float32).copy()
+    n = res.shape[0]
+    capacity = min(int(capacity), n)
+    idx = np.zeros(capacity, np.int32)
+    signs = np.zeros(capacity, np.int8)
+    count = lib.threshold_encode(
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+        ctypes.c_float(threshold), capacity,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        signs.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    return idx, signs, int(count), res
+
+
+def native_threshold_decode(idx: np.ndarray, signs: np.ndarray,
+                            threshold: float, size: int) -> np.ndarray:
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native threshold codec unavailable (no g++?); "
+                           "use ops.compression.threshold_decode instead")
+    idx = np.ascontiguousarray(idx, np.int32)
+    signs = np.ascontiguousarray(signs, np.int8)
+    out = np.zeros(size, np.float32)
+    lib.threshold_decode(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        signs.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        idx.shape[0], ctypes.c_float(threshold),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
+    return out

@@ -1,0 +1,72 @@
+// Host-side threshold compression codec.
+//
+// Reference: the ND4J NATIVE ops behind EncodingHandler.java:64-66
+// (Nd4j.getExecutioner().thresholdEncode/thresholdDecode) — the reference's
+// sparse sign+threshold quantizer is C++ in libnd4j; this is the TPU build's
+// native equivalent for the host/DCN boundary (the on-device variant is
+// ops/compression.py). Semantics are kept bit-identical to the XLA path:
+// top-`capacity` entries by |residual| (ties broken by LOWER index, matching
+// jax.lax.top_k), entries clearing `threshold` are quantized to +-threshold
+// and subtracted from the residual (Strom error feedback).
+//
+// Built with: g++ -O3 -shared -fPIC threshold_codec.cpp -o libthreshold_codec.so
+// Loaded via ctypes (deeplearning4j_tpu/native/__init__.py) — no pybind11.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Encode the largest-magnitude entries of residual[n] that clear `threshold`.
+// Writes up to `capacity` (index, sign) pairs; unused slots get sign 0 (their
+// index is still the top-k index, mirroring the XLA payload layout). Residual
+// is updated IN PLACE (sent mass subtracted). Returns the live-entry count.
+int threshold_encode(float* residual, int64_t n, float threshold,
+                     int64_t capacity, int32_t* idx_out, int8_t* sign_out) {
+  if (capacity > n) capacity = n;
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  // top-`capacity` by magnitude, ties -> lower index first (jax.lax.top_k)
+  std::partial_sort(order.begin(), order.begin() + capacity, order.end(),
+                    [&](int64_t a, int64_t b) {
+                      float ma = std::fabs(residual[a]);
+                      float mb = std::fabs(residual[b]);
+                      if (ma != mb) return ma > mb;
+                      return a < b;
+                    });
+  int count = 0;
+  for (int64_t k = 0; k < capacity; ++k) {
+    int64_t i = order[k];
+    idx_out[k] = static_cast<int32_t>(i);
+    float v = residual[i];
+    if (std::fabs(v) >= threshold) {
+      int8_t s = (v > 0.0f) ? 1 : ((v < 0.0f) ? -1 : 0);
+      sign_out[k] = s;
+      residual[i] -= s * threshold;
+      if (s != 0) ++count;
+    } else {
+      sign_out[k] = 0;
+    }
+  }
+  return count;
+}
+
+// Reconstruct the dense update a payload represents (SilentTrainingDriver
+// thresholdDecode): out[idx[k]] += sign[k] * threshold. `out` must be
+// zero-initialized by the caller (or hold a partial sum to accumulate into —
+// the receiving-accumulator semantics of the reference).
+void threshold_decode(const int32_t* idx, const int8_t* signs,
+                      int64_t capacity, float threshold, float* out,
+                      int64_t n) {
+  for (int64_t k = 0; k < capacity; ++k) {
+    int32_t i = idx[k];
+    if (i >= 0 && i < n && signs[k] != 0) {
+      out[i] += signs[k] * threshold;
+    }
+  }
+}
+
+}  // extern "C"

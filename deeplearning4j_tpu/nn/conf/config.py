@@ -147,9 +147,16 @@ class ListBuilder:
         return self
 
     def tbptt_length(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
+        """See GraphBuilder.tbptt_length: the fused XLA chunk step backprops
+        through the whole chunk, so bwd != fwd is rejected, not ignored."""
         self._backprop_type = "tbptt"
+        if bwd is not None and bwd != fwd:
+            raise ValueError(
+                "tbptt bwd length must equal fwd length: the fused XLA chunk "
+                "step computes exact gradients over the full chunk, so "
+                "bwd<fwd truncation has no cost to avoid here")
         self._tbptt_fwd = fwd
-        self._tbptt_bwd = bwd if bwd is not None else fwd
+        self._tbptt_bwd = fwd
         return self
 
     def pretrain(self, flag: bool) -> "ListBuilder":

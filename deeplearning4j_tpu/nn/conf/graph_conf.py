@@ -80,6 +80,31 @@ class GraphBuilder:
         self._vertices: Dict[str, VertexConf] = {}
         self._vertex_inputs: Dict[str, List[str]] = {}
         self._input_types: Optional[List[Any]] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_bwd = 20
+
+    def backprop_type(self, bp: str) -> "GraphBuilder":
+        self._backprop_type = bp
+        return self
+
+    def tbptt_length(self, fwd: int, bwd: Optional[int] = None) -> "GraphBuilder":
+        """Enable truncated BPTT with the given chunk length (reference
+        ComputationGraphConfiguration.GraphBuilder tBPTT settings).
+
+        The jitted chunk step backprops through the WHOLE chunk (one fused
+        XLA program), so a shorter backward truncation would only discard
+        gradient terms without saving work; bwd != fwd is therefore rejected
+        rather than silently ignored."""
+        self._backprop_type = "tbptt"
+        if bwd is not None and bwd != fwd:
+            raise ValueError(
+                "tbptt bwd length must equal fwd length: the fused XLA chunk "
+                "step computes exact gradients over the full chunk, so "
+                "bwd<fwd truncation has no cost to avoid here")
+        self._tbptt_fwd = fwd
+        self._tbptt_bwd = fwd
+        return self
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -144,6 +169,8 @@ class GraphBuilder:
             vertex_names=order, vertices=dict(self._vertices),
             vertex_inputs=dict(self._vertex_inputs),
             input_types=self._input_types, seed=nc.seed, dtype=nc.dtype,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd, tbptt_bwd_length=self._tbptt_bwd,
             gradient_normalization=nc.gradient_normalization,
             gradient_normalization_threshold=nc.gradient_normalization_threshold,
             updater=nc.updater)

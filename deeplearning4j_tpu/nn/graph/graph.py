@@ -51,6 +51,7 @@ class ComputationGraph:
         self.opt_state = None
         self.iteration_count = 0
         self.listeners: List[Any] = []
+        self._rnn_state: Optional[list] = None
         self._jit_cache: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------ init
@@ -79,9 +80,17 @@ class ComputationGraph:
 
     # ------------------------------------------------------------- functional
     def apply_fn(self, params, state, inputs, *, train=False, rng=None,
-                 features_masks=None):
+                 features_masks=None, rnn_states=None,
+                 collect_rnn_states: bool = False):
         """Forward in topo order. Returns (activations: dict name->array,
-        new_state tuple)."""
+        new_state tuple) — or (acts, new_state, rnn_states_out) when
+        ``collect_rnn_states`` (the tBPTT/streaming carry; reference
+        ComputationGraph.rnnTimeStep :2301, tBPTT state sync :908).
+
+        Per-timestep feature masks propagate vertex-to-vertex: a vertex's mask
+        is its first input's mask, dropped once the time dimension collapses
+        (reference MaskState flow through GraphVertex.setMaskArrays).
+        """
         inputs = _as_list(inputs)
         if rng is None:
             rng = jax.random.PRNGKey(0)
@@ -91,11 +100,17 @@ class ComputationGraph:
             masks.update({k: m for k, m in zip(self.conf.network_inputs,
                                                _as_list(features_masks)) if m is not None})
         new_state = []
+        rnn_out = [None] * len(self.vertices)
         for idx, (name, v) in enumerate(zip(self.vertex_names, self.vertices)):
-            vin = [acts[i] for i in self.conf.vertex_inputs[name]]
+            in_names = self.conf.vertex_inputs[name]
+            vin = [acts[i] for i in in_names]
+            in_mask = next((masks[i] for i in in_names if i in masks), None)
             rng, sub = jax.random.split(rng)
             if isinstance(v, LastTimeStepVertex):
-                mask = masks.get(v.mask_input) if v.mask_input else None
+                mask = masks.get(v.mask_input) if v.mask_input else in_mask
+                if mask is not None and getattr(vin[0], "ndim", 0) == 3 and \
+                        mask.shape[1] != vin[0].shape[1]:
+                    mask = None   # sequence length changed upstream
                 out, s = v.apply(params[idx], state[idx], vin, train=train,
                                  rng=sub, mask=mask)
             elif isinstance(v, DuplicateToTimeSeriesVertex):
@@ -104,24 +119,54 @@ class ComputationGraph:
                     t = acts[v.reference_input].shape[1]
                 out, s = v.apply(params[idx], state[idx], vin, train=train,
                                  rng=sub, timesteps=t)
+            elif isinstance(v, LayerVertex) and v.recurrent and \
+                    (collect_rnn_states or (rnn_states is not None
+                                            and rnn_states[idx] is not None)):
+                init = rnn_states[idx] if rnn_states is not None else None
+                out, final = v.apply_with_final_state(
+                    params[idx], state[idx], vin, train=train, rng=sub,
+                    mask=in_mask, initial_state=init)
+                s = state[idx]
+                rnn_out[idx] = final
+            elif isinstance(v, LayerVertex):
+                out, s = v.apply(params[idx], state[idx], vin, train=train,
+                                 rng=sub, mask=in_mask)
             else:
                 out, s = v.apply(params[idx], state[idx], vin, train=train, rng=sub)
             acts[name] = out
             new_state.append(s)
+            # propagate only while the time axis is unchanged — a vertex that
+            # alters sequence length (e.g. strided Convolution1D) invalidates
+            # the [B,T] mask for its consumers
+            if in_mask is not None and getattr(out, "ndim", 0) == 3 and \
+                    out.shape[1] == in_mask.shape[1]:
+                masks[name] = in_mask
+        if collect_rnn_states:
+            return acts, tuple(new_state), rnn_out
         return acts, tuple(new_state)
 
     def loss_fn(self, params, state, x, labels, *, train=True, rng=None,
-                labels_mask=None, features_mask=None):
+                labels_mask=None, features_mask=None, rnn_states=None,
+                collect_rnn_states: bool = False):
         """Sum of output-layer losses + regularization (reference
-        ComputationGraph.computeGradientAndScore :1245)."""
+        ComputationGraph.computeGradientAndScore :1245). With
+        ``collect_rnn_states`` the aux also carries each recurrent vertex's
+        final state — the tBPTT chunk carry (reference tBPTT branch :908)."""
         inputs = _as_list(x)
         labels = _as_list(labels)
         lmasks = _as_list(labels_mask) or [None] * len(labels)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         rng, fwd = jax.random.split(rng)
-        acts, new_state = self.apply_fn(params, state, inputs, train=train,
-                                        rng=fwd, features_masks=features_mask)
+        rnn_out = None
+        res = self.apply_fn(params, state, inputs, train=train,
+                            rng=fwd, features_masks=features_mask,
+                            rnn_states=rnn_states,
+                            collect_rnn_states=collect_rnn_states)
+        if collect_rnn_states:
+            acts, new_state, rnn_out = res
+        else:
+            acts, new_state = res
         total = 0.0
         for k, out_name in enumerate(self.conf.network_outputs):
             vi = self.vertex_names.index(out_name)
@@ -152,6 +197,8 @@ class ComputationGraph:
                 total = total + jnp.mean(per_ex)
         for layer, p in zip(self.layers, params):
             total = total + layer.regularization(p)
+        if collect_rnn_states:
+            return total, (new_state, rnn_out)
         return total, new_state
 
     # ------------------------------------------------------------- inference
@@ -185,6 +232,35 @@ class ComputationGraph:
         x = [jnp.asarray(v) for v in _as_list(x)]
         y = [jnp.asarray(v) for v in _as_list(y)]
         return float(fn(self.params, self.state, x, y))
+
+    # -------------------------------------------------------------- streaming
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference (reference
+        ComputationGraph.rnnTimeStep :2301): feed [B,F] one step (or [B,T,F]
+        a chunk) per network input; recurrent vertex state is carried between
+        calls. Returns the network output(s) for the fed step(s)."""
+        dtype = jnp.dtype(self.conf.dtype)
+        xs = [jnp.asarray(i, dtype) for i in inputs]
+        single = all(x.ndim == 2 for x in xs)
+        if single:
+            xs = [x[:, None, :] for x in xs]
+
+        def fn(params, state, rnn_states, xx):
+            acts, _, rnn_out = self.apply_fn(params, state, xx, train=False,
+                                             rnn_states=rnn_states,
+                                             collect_rnn_states=True)
+            return [acts[o] for o in self.conf.network_outputs], rnn_out
+
+        key = ("rnn_time_step", tuple(x.shape[1] for x in xs),
+               self._rnn_state is None)
+        jfn = self._jitted(key, fn)
+        outs, self._rnn_state = jfn(self.params, self.state, self._rnn_state, xs)
+        if single:
+            outs = [o[:, -1] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
 
     # ------------------------------------------------------------ flat params
     def params_flat(self) -> jnp.ndarray:

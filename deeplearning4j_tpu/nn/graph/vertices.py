@@ -65,11 +65,36 @@ class LayerVertex(VertexConf):
             it = self.preprocessor.output_type(it)
         return self.layer_conf.init(rng, it, dtype)
 
-    def apply(self, params, state, inputs, *, train=False, rng=None):
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
         x = inputs[0]
         if self.preprocessor is not None:
             x = self.preprocessor.apply(x)
-        return self.layer_conf.apply(params, state, x, train=train, rng=rng)
+        kwargs = {}
+        if mask is not None and getattr(self.layer_conf, "accepts_mask", False) \
+                and x.ndim == 3:
+            kwargs["mask"] = mask
+        return self.layer_conf.apply(params, state, x, train=train, rng=rng,
+                                     **kwargs)
+
+    def apply_with_final_state(self, params, state, inputs, *, train=False,
+                               rng=None, mask=None, initial_state=None):
+        """Recurrent-layer passthrough for tBPTT/streaming state carry
+        (reference GraphVertex wrapping a RecurrentLayer;
+        ComputationGraph.rnnTimeStep :2301)."""
+        x = inputs[0]
+        if self.preprocessor is not None:
+            x = self.preprocessor.apply(x)
+        kwargs = {}
+        if mask is not None and getattr(self.layer_conf, "accepts_mask", False) \
+                and x.ndim == 3:
+            kwargs["mask"] = mask
+        return self.layer_conf.apply_with_final_state(
+            params, state, x, train=train, rng=rng, initial_state=initial_state,
+            **kwargs)
+
+    @property
+    def recurrent(self):
+        return hasattr(self.layer_conf, "apply_with_final_state")
 
 
 @register

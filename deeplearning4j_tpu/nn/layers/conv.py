@@ -83,12 +83,14 @@ class ConvolutionLayer(LayerConf):
             pad = "SAME"
         else:
             pad = [(ph, ph), (pw, pw)]
+        # no preferred_element_type: the TPU MXU already accumulates bf16
+        # matmuls in f32, and forcing f32 outputs breaks the conv VJP
+        # (f32 cotangent vs bf16 kernel in the transpose conv)
         y = lax.conv_general_dilated(
             x, params["W"], window_strides=(sh, sw), padding=pad,
             rhs_dilation=(dh, dw),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-        return (y + params["b"]).astype(x.dtype)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + params["b"]
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return self.act(self.pre_output(params, x, train=train, rng=rng)), state

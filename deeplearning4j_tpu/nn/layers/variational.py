@@ -444,8 +444,15 @@ class RBM(LayerConf):
             jnp.mean(self.free_energy(params, v_model))
 
     def reconstruct(self, params, x):
-        """Deterministic one-step reconstruction (mean-field v->h->v)."""
-        h = jax.nn.sigmoid(x @ params["W"] + params["b"])
+        """Deterministic one-step reconstruction (mean-field v->h->v) using
+        each unit type's conditional mean: sigmoid for binary hiddens,
+        relu(pre) for rectified (NReLU) — consistent with free_energy and
+        the Gibbs sampler."""
+        pre_h = x @ params["W"] + params["b"]
+        if self.hidden_unit == "rectified":
+            h = jnp.maximum(pre_h, 0.0)
+        else:
+            h = jax.nn.sigmoid(pre_h)
         pre_v = h @ params["W"].T + params["vb"]
         if self.visible_unit == "gaussian":
             return pre_v

@@ -31,8 +31,6 @@ def _dtype_of(conf) -> Any:
 
 
 class MultiLayerNetwork:
-    supports_tbptt = True
-
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = tuple(conf.layers)

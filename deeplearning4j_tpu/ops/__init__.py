@@ -1,0 +1,5 @@
+from .compression import (ThresholdPayload, threshold_decode,
+                          threshold_encode, threshold_roundtrip)
+
+__all__ = ["ThresholdPayload", "threshold_decode", "threshold_encode",
+           "threshold_roundtrip"]

@@ -1,0 +1,82 @@
+"""Threshold gradient compression: sparse sign+threshold quantization.
+
+Reference: optimize/solvers/accumulation/EncodingHandler.java:64-66
+(Nd4j.getExecutioner().thresholdEncode(gradients, threshold) — a native ND4J
+op producing a sparse index/sign payload of every element whose magnitude
+exceeds the threshold, SUBTRACTING the quantized value from the residual
+buffer) and SilentTrainingDriver.java:142 (thresholdDecode on the receiver).
+This is the Strom-style 1-bit/threshold compression the reference ships
+updates with over Aeron UDP (SURVEY.md §2.6.4, §5.8).
+
+TPU-first reshape: XLA has no dynamic sparse shapes, so the payload has a
+STATIC capacity — the top-`capacity` residual entries by magnitude that also
+clear the threshold (top_k keeps the op on-device and the payload shape
+compile-time constant). The payload (int32 indices + int8 signs) is what a
+DCN hop would ship: ~5 bytes/element vs 4 bytes/element dense, i.e.
+capacity/size compression. On ICI, plain psum is strictly better (see
+parallel/data_parallel.py); this op exists for the DCN capability and for
+parity with the reference's EncodingHandler semantics. A C++ host-side codec
+with identical semantics lives in native/ for the host/DCN boundary.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ThresholdPayload(NamedTuple):
+    """The compressed message: static-capacity sparse sign+index payload.
+    ``signs`` is 0 for unused slots (below threshold or beyond count)."""
+    indices: jnp.ndarray   # [capacity] int32
+    signs: jnp.ndarray     # [capacity] int8 in {-1, 0, +1}
+    count: jnp.ndarray     # [] int32 — number of live entries
+
+
+def threshold_encode(residual: jnp.ndarray, threshold: float,
+                     capacity: int) -> Tuple[ThresholdPayload, jnp.ndarray]:
+    """Encode the largest-magnitude entries of ``residual`` that exceed
+    ``threshold`` as +-threshold, subtracting what was sent from the residual
+    (reference EncodingHandler.encodeUpdates: the residual carry is what makes
+    threshold SGD converge).
+
+    Returns (payload, new_residual). ``residual`` must be 1-D (the flat
+    gradient view, reference flattenedGradients).
+    """
+    if residual.ndim != 1:
+        raise ValueError(f"threshold_encode expects the flat 1-D gradient "
+                         f"view, got shape {residual.shape}")
+    capacity = min(int(capacity), residual.shape[0])
+    mags, idx = jax.lax.top_k(jnp.abs(residual), capacity)
+    live = mags >= threshold
+    signs = jnp.where(live, jnp.sign(residual[idx]), 0.0)
+    sent = jnp.zeros_like(residual).at[idx].add(
+        signs * jnp.asarray(threshold, residual.dtype),
+        mode="drop")
+    payload = ThresholdPayload(indices=idx.astype(jnp.int32),
+                               signs=signs.astype(jnp.int8),
+                               count=jnp.sum(live).astype(jnp.int32))
+    return payload, residual - sent
+
+
+def threshold_decode(payload: ThresholdPayload, threshold: float, size: int,
+                     dtype=jnp.float32) -> jnp.ndarray:
+    """Reconstruct the dense update a payload represents (reference
+    SilentTrainingDriver.java:142 thresholdDecode)."""
+    out = jnp.zeros((size,), dtype)
+    return out.at[payload.indices].add(
+        payload.signs.astype(dtype) * jnp.asarray(threshold, dtype),
+        mode="drop")
+
+
+@partial(jax.jit, static_argnames=("threshold", "capacity"))
+def threshold_roundtrip(residual, *, threshold: float, capacity: int):
+    """encode+decode in one jitted program — the exact dense update peers will
+    apply, plus the residual carried to the next step. Used by the
+    EncodedAccumulator and by tests."""
+    payload, new_residual = threshold_encode(residual, threshold, capacity)
+    update = threshold_decode(payload, threshold, residual.shape[0],
+                              residual.dtype)
+    return update, new_residual, payload

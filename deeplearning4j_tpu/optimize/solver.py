@@ -72,17 +72,47 @@ class Solver:
         return self._steps[key]
 
     def _fit_tbptt_batch(self, x, y, lmask, fmask, base_rng):
+        """Chunked tBPTT over the time axis. Works for single-array MLN data
+        and for ComputationGraph multi-input/multi-output lists (reference
+        MultiLayerNetwork.doTruncatedBPTT :1312; ComputationGraph tBPTT branch
+        :908): time-series arrays ([B,T,F], and [B,T] masks) are chunked;
+        static 2-D inputs/labels are fed whole to every chunk."""
         net = self.net
-        T = x.shape[1]
+        time_lens = [v.shape[1] for v in (x if isinstance(x, list) else [x])
+                     if v.ndim == 3]
+        # a seq2seq graph can have only static 2-D inputs with time-series
+        # LABELS (DuplicateToTimeSeriesVertex expands them); chunk by those
+        time_lens += [v.shape[1] for v in (y if isinstance(y, list) else [y])
+                      if v is not None and v.ndim == 3]
+        if not time_lens:
+            raise ValueError("tBPTT requires at least one [B,T,F] time-series "
+                             "input or label")
+        if len(set(time_lens)) > 1:
+            raise ValueError(
+                f"tBPTT requires all time-series inputs/labels to share one "
+                f"sequence length, got {sorted(set(time_lens))} (chunking "
+                f"mixed-length sequences would misalign the carry)")
+        T = time_lens[0]
         k = net.conf.tbptt_fwd_length
+
+        def ch3(v, t0, t1):      # features/labels: chunk 3-D time series only
+            if isinstance(v, list):
+                return [ch3(u, t0, t1) for u in v]
+            return v[:, t0:t1] if (v is not None and v.ndim == 3) else v
+
+        def chm(m, t0, t1):      # [B,T] per-timestep masks
+            if isinstance(m, list):
+                return [chm(u, t0, t1) for u in m]
+            return m[:, t0:t1] if (m is not None and m.ndim == 2) else m
+
         rnn_states = None
         loss = None
         for t0 in range(0, T, k):
             t1 = min(t0 + k, T)
-            xc = x[:, t0:t1]
-            yc = y[:, t0:t1] if y.ndim == 3 else y
-            lc = lmask[:, t0:t1] if (lmask is not None and lmask.ndim == 2) else lmask
-            fc = fmask[:, t0:t1] if (fmask is not None and fmask.ndim == 2) else fmask
+            xc = ch3(x, t0, t1)
+            yc = ch3(y, t0, t1)
+            lc = chm(lmask, t0, t1)
+            fc = chm(fmask, t0, t1)
             step_fn = self._get_tbptt_step(lc is not None, fc is not None, t1 - t0)
             rng = jax.random.fold_in(base_rng, net.iteration_count)
             kwargs = {}
@@ -103,10 +133,6 @@ class Solver:
         if net.params is None:
             net.init()
         tbptt = net.conf.backprop_type == "tbptt"
-        if tbptt and not getattr(net, "supports_tbptt", False):
-            raise NotImplementedError(
-                "Truncated BPTT is supported on MultiLayerNetwork; "
-                "ComputationGraph tBPTT lands in a later round")
         if iterator is None:
             if dataset is not None:
                 iterator = ListDataSetIterator([dataset])

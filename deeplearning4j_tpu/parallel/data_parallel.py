@@ -52,7 +52,8 @@ class ParallelWrapper:
     def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, training_mode: str = "shared_gradients",
                  average_updaters: bool = True, prefetch_buffer: int = 2,
-                 report_score_after_averaging: bool = True):
+                 report_score_after_averaging: bool = True,
+                 gradient_accumulator=None):
         self.net = net
         devices = jax.devices()
         if workers is not None and mesh is None:
@@ -64,6 +65,17 @@ class ParallelWrapper:
         self.training_mode = training_mode.lower()
         self.average_updaters = average_updaters
         self.prefetch_buffer = prefetch_buffer
+        # GradientsAccumulator seam (reference GradientsAccumulator.java SPI;
+        # see parallel/accumulation.py). None -> GSPMD-inserted psum.
+        self.gradient_accumulator = gradient_accumulator
+        if gradient_accumulator is not None and \
+                self.training_mode == "averaging" and self.averaging_frequency > 1:
+            raise ValueError(
+                "gradient_accumulator applies to the per-step gradient-sharing "
+                "path (training_mode='shared_gradients'), not K-step parameter "
+                "averaging — the reference makes the same split "
+                "(ParallelWrapper.TrainingMode AVERAGING vs SHARED_GRADIENTS)")
+        self._acc_state = None
         self._sync_step = None
         self._avg_steps = {}   # keyed by chunk count (remainder batches differ)
 
@@ -86,6 +98,47 @@ class ParallelWrapper:
             step, donate_argnums=(0, 2),
             in_shardings=(rep, rep, rep, rep, rep, dsh, dsh),
             out_shardings=(rep, rep, rep, rep))
+
+    # ------------------------------------------------------ accumulator path
+    def _build_accum_step(self):
+        """Sync DP with an explicit GradientsAccumulator combining per-worker
+        flat gradients inside shard_map (reference StochasticGradientDescent
+        accumulator hook :67-74 + EncodingHandler exchange). The accumulator
+        carry (e.g. the threshold-compression residual) is per-worker: global
+        shape [n_workers, n_params] sharded on the data axis."""
+        net = self.net
+        mesh = self.mesh
+        acc = self.gradient_accumulator
+        from jax.flatten_util import ravel_pytree
+
+        def worker_step(params, state, opt_state, acc_state, it, rng, x, y):
+            def lf(p):
+                return net.loss_fn(p, state, x, y, train=True, rng=rng)
+            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            flat, unravel = ravel_pytree(grads)
+            combined, new_acc = acc.combine(flat, acc_state[0], axis="data")
+            # combined grads are identical on every worker, so the updater
+            # math (and its replicated state) stays in lockstep
+            new_params, new_opt = net.updater.update(unravel(combined),
+                                                     opt_state, params, it)
+            new_state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), new_state)
+            return (new_params, new_state, new_opt, new_acc[None],
+                    jax.lax.pmean(loss, "data"))
+
+        rep, dsh = P(), P("data")
+        fn = shard_map(worker_step, mesh=mesh,
+                       in_specs=(rep, rep, rep, dsh, rep, rep, dsh, dsh),
+                       out_specs=(rep, rep, rep, dsh, rep),
+                       check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2, 3))
+
+    def _init_acc_state(self, dtype):
+        size = int(self.net.num_params())
+        per_worker = self.gradient_accumulator.init(size, dtype)
+        if isinstance(per_worker, tuple) and per_worker == ():
+            # stateless accumulator (PsumAccumulator)
+            per_worker = jnp.zeros((0,), dtype)
+        return jnp.broadcast_to(per_worker, (self.n,) + per_worker.shape).copy()
 
     # -------------------------------------------------------- averaging path
     def _build_avg_step(self):
@@ -135,7 +188,9 @@ class ParallelWrapper:
             net.init()
         sync = self.training_mode == "shared_gradients" or self.averaging_frequency == 1
         if sync and self._sync_step is None:
-            self._sync_step = self._build_sync_step()
+            self._sync_step = (self._build_accum_step()
+                               if self.gradient_accumulator is not None
+                               else self._build_sync_step())
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 31337)
         perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
@@ -150,9 +205,17 @@ class ParallelWrapper:
                     x = jnp.asarray(np.asarray(ds.features), dtype)
                     y = jnp.asarray(np.asarray(ds.labels), dtype)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
-                    net.params, net.state, net.opt_state, loss = self._sync_step(
-                        net.params, net.state, net.opt_state,
-                        jnp.asarray(net.iteration_count, jnp.int32), rng, x, y)
+                    it = jnp.asarray(net.iteration_count, jnp.int32)
+                    if self.gradient_accumulator is not None:
+                        if self._acc_state is None:
+                            self._acc_state = self._init_acc_state(dtype)
+                        (net.params, net.state, net.opt_state,
+                         self._acc_state, loss) = self._sync_step(
+                            net.params, net.state, net.opt_state,
+                            self._acc_state, it, rng, x, y)
+                    else:
+                        net.params, net.state, net.opt_state, loss = self._sync_step(
+                            net.params, net.state, net.opt_state, it, rng, x, y)
                     self._notify(perf, ds, loss)
                     net.iteration_count += 1
             else:

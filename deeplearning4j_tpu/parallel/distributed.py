@@ -1,0 +1,86 @@
+"""Multi-host distributed entry point.
+
+Reference: the Spark/Aeron orchestration layer — SharedTrainingMaster.java
+:46-53,464 (VoidParameterServer + RoutedTransport bootstrap across executors)
+and ParameterAveragingTrainingMaster's driver-centric broadcast/aggregate.
+The TPU build replaces ALL of it with the JAX coordination service +
+XLA collectives (SURVEY.md §5.8): every process calls
+``initialize_distributed`` once at startup, after which ``jax.devices()`` is
+the GLOBAL device list and any Mesh built over it spans hosts — pjit/GSPMD
+then emit ICI/DCN collectives; no parameter server, no hand-rolled transport.
+
+Usage (one process per host, e.g. under a TPU pod scheduler):
+
+    from deeplearning4j_tpu.parallel import distributed
+    distributed.initialize_distributed()          # env-driven on TPU pods
+    mesh = distributed.global_mesh(("data",))
+    pw = ParallelWrapper(net, mesh=mesh)          # same API as single-host
+
+Tested without real multi-host hardware via 2 CPU processes + gloo
+collectives (tests/test_distributed.py — the analogue of the reference's
+Spark local[n] testing, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           local_device_ids=None,
+                           cpu_collectives: Optional[str] = None) -> None:
+    """Join (or start) the JAX coordination service.
+
+    On real TPU pods all arguments are inferred from the environment
+    (jax.distributed reads the TPU metadata); pass them explicitly for
+    CPU/GPU clusters. ``cpu_collectives``: set "gloo" when running
+    multi-process on CPU (the test configuration).
+    """
+    import jax
+    if cpu_collectives:
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+
+
+def shutdown_distributed() -> None:
+    import jax
+    jax.distributed.shutdown()
+
+
+def global_mesh(axis_names: Sequence[str] = ("data",),
+                shape: Optional[Tuple[int, ...]] = None):
+    """Mesh over the GLOBAL device list (all processes). With the default
+    1-D shape this is the multi-host data axis the ParallelWrapper shards
+    batches over."""
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"Mesh shape {shape} must cover all {len(devices)} "
+                         f"global devices")
+    return Mesh(np.array(devices).reshape(shape), tuple(axis_names))
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()

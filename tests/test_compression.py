@@ -1,0 +1,143 @@
+"""Threshold compression + GradientsAccumulator seam (reference
+EncodingHandler.java:64-66 thresholdEncode/Decode semantics, residual error
+feedback, and DP training through the accumulator hook)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.compression import (ThresholdPayload,
+                                                threshold_decode,
+                                                threshold_encode,
+                                                threshold_roundtrip)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel.accumulation import (EncodedAccumulator,
+                                                      PsumAccumulator)
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+R = np.random.default_rng(13)
+
+
+def test_encode_decode_roundtrip_quantizes_above_threshold():
+    g = jnp.asarray([0.5, -0.2, 0.05, -0.9, 0.0, 0.11])
+    payload, residual = threshold_encode(g, threshold=0.1, capacity=6)
+    update = threshold_decode(payload, 0.1, 6, g.dtype)
+    # entries with |g| >= 0.1 became +-0.1; others 0
+    np.testing.assert_allclose(np.asarray(update),
+                               [0.1, -0.1, 0.0, -0.1, 0.0, 0.1], atol=1e-7)
+    assert int(payload.count) == 4
+    # residual carries exactly what was not sent
+    np.testing.assert_allclose(np.asarray(residual + update), np.asarray(g),
+                               atol=1e-7)
+
+
+def test_encode_capacity_caps_payload():
+    g = jnp.asarray(R.normal(size=(100,)).astype(np.float32))
+    payload, residual = threshold_encode(g, threshold=1e-4, capacity=10)
+    assert payload.indices.shape == (10,)
+    assert int(payload.count) <= 10
+    update = threshold_decode(payload, 1e-4, 100, g.dtype)
+    assert int(jnp.sum(update != 0)) <= 10
+    # the 10 sent entries are the largest-magnitude ones
+    sent_idx = set(np.asarray(payload.indices).tolist())
+    top10 = set(np.argsort(-np.abs(np.asarray(g)))[:10].tolist())
+    assert sent_idx == top10
+
+
+def test_residual_feedback_retransmits_small_values():
+    """A value below threshold must accumulate in the residual and be sent
+    once it crosses the threshold (Strom error feedback)."""
+    size = 4
+    residual = jnp.zeros((size,), jnp.float32)
+    g = jnp.asarray([0.04, 0.0, 0.0, 0.0], jnp.float32)
+    sent_total = np.zeros(size, np.float32)
+    for _ in range(5):   # 5 * 0.04 = 0.2 -> two 0.1-quanta sent along the way
+        update, residual, _ = threshold_roundtrip(residual + g,
+                                                  threshold=0.1, capacity=4)
+        sent_total += np.asarray(update)
+    np.testing.assert_allclose(sent_total[0] + float(residual[0]), 0.2,
+                               atol=1e-6)
+    assert sent_total[0] > 0.0
+
+
+def test_roundtrip_is_jittable_static_shapes():
+    g = jnp.asarray(R.normal(size=(1000,)).astype(np.float32))
+    update, residual, payload = threshold_roundtrip(g, threshold=0.01,
+                                                    capacity=100)
+    assert payload.indices.shape == (100,)
+    assert payload.signs.dtype == jnp.int8
+    np.testing.assert_allclose(np.asarray(update + residual), np.asarray(g),
+                               atol=1e-6)
+
+
+def _dp_net(updater=None):
+    conf = (NeuralNetConfiguration(seed=4, updater=updater or Sgd(0.1),
+                                   dtype="float32")
+            .list(DenseLayer(n_in=6, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _dp_data(n=128):
+    x = R.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+    return x, y
+
+
+def test_psum_accumulator_matches_default_sync_path():
+    """The accumulator seam with an exact PsumAccumulator must reproduce the
+    GSPMD-psum path bit-for-bit (same math, different plumbing)."""
+    x, y = _dp_data()
+    it = ListDataSetIterator(features=x, labels=y, batch_size=64)
+    a = _dp_net()
+    b = _dp_net()
+    b.set_params_flat(a.params_flat())
+    ParallelWrapper(a).fit(it, epochs=2)
+    it.reset()
+    ParallelWrapper(b, gradient_accumulator=PsumAccumulator()).fit(it, epochs=2)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=1e-6)
+
+
+def test_encoded_accumulator_converges():
+    """DP training through threshold compression still learns the task
+    (reference convergence claim for threshold SGD with error feedback)."""
+    x, y = _dp_data(256)
+    it = ListDataSetIterator(features=x, labels=y, batch_size=64)
+    # raw-gradient quanta are +-threshold, so the effective step per entry is
+    # lr*threshold — pick them jointly (the reference encodes post-updater
+    # updates, where lr is already folded in)
+    net = _dp_net(updater=Sgd(2.0))
+    acc = EncodedAccumulator(threshold=0.01, capacity_fraction=0.5)
+    pw = ParallelWrapper(net, gradient_accumulator=acc)
+    s0 = net.score(x, y)
+    pw.fit(it, epochs=25)
+    s1 = net.score(x, y)
+    assert s1 < s0
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.8
+    # residuals are per-worker state with the mesh leading dim
+    assert pw._acc_state.shape == (pw.n, net.num_params())
+
+
+def test_native_codec_matches_xla_path():
+    """The C++ host codec (native/threshold_codec.cpp — the analogue of the
+    reference's native ND4J thresholdEncode/Decode) must agree exactly with
+    the XLA implementation."""
+    from deeplearning4j_tpu import native
+    if not native.available():
+        pytest.skip("no C++ toolchain on this host")
+    g = R.normal(size=(500,)).astype(np.float32)
+    for threshold, capacity in [(0.01, 50), (0.5, 500), (2.0, 100)]:
+        payload, res_x = threshold_encode(jnp.asarray(g), threshold, capacity)
+        idx, signs, count, res_c = native.native_threshold_encode(
+            g, threshold, capacity)
+        assert count == int(payload.count)
+        np.testing.assert_allclose(res_c, np.asarray(res_x), atol=1e-6)
+        dec_x = threshold_decode(payload, threshold, 500, jnp.float32)
+        dec_c = native.native_threshold_decode(idx, signs, threshold, 500)
+        np.testing.assert_allclose(dec_c, np.asarray(dec_x), atol=1e-6)

@@ -1,0 +1,118 @@
+"""ComputationGraph recurrent capability: tBPTT training, rnn_time_step
+streaming, seq2seq graphs, recurrent CG gradient checks with masking
+(reference ComputationGraph.java rnnTimeStep :2301, tBPTT branch :908;
+GradientCheckTestsComputationGraph + GradientCheckTestsMasking)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (DuplicateToTimeSeriesVertex,
+                                                  LastTimeStepVertex)
+from deeplearning4j_tpu.nn.layers import (DenseLayer, GravesLSTM, LSTM,
+                                          OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.util.gradcheck import check_gradients
+
+R = np.random.default_rng(31)
+
+
+def _seq2seq(tbptt=None, dtype="float32", updater=None, seed=5):
+    """Encoder LSTM -> LastTimeStep -> DuplicateToTimeSeries -> decoder LSTM
+    -> RnnOutput (the reference's canonical seq2seq CG shape)."""
+    g = (NeuralNetConfiguration(seed=seed, updater=updater or Adam(5e-3),
+                                dtype=dtype)
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("enc", LSTM(n_out=8, activation="tanh"), "in")
+         .add_vertex("last", LastTimeStepVertex(mask_input="in"), "enc")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex(reference_input="in"),
+                     "last")
+         .add_layer("dec", LSTM(n_out=8, activation="tanh"), "dup")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dec")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(4, 6)))
+    if tbptt:
+        g = g.tbptt_length(tbptt)
+    return ComputationGraph(g.build()).init()
+
+
+def _seq_data(n=16, t=6, f=4, c=3):
+    x = R.normal(size=(n, t, f)).astype(np.float32)
+    yi = (np.cumsum(x.sum(-1), axis=1) > 0).astype(int)
+    y = np.eye(c, dtype=np.float32)[np.clip(yi, 0, c - 1)]
+    return x, y
+
+
+def test_seq2seq_trains_with_tbptt():
+    net = _seq2seq(tbptt=3)
+    x, y = _seq_data()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=20, batch_size=16)
+    assert net.score(x, y) < s0
+    assert net.iteration_count == 20 * 2  # 2 chunks of 3 per batch of T=6
+
+
+def test_cg_tbptt_single_chunk_matches_standard_step():
+    """With chunk length >= T one tBPTT step must equal one standard step."""
+    x, y = _seq_data(n=8)
+    a = _seq2seq(tbptt=None, updater=Sgd(0.1), seed=11)
+    b = _seq2seq(tbptt=10, updater=Sgd(0.1), seed=11)
+    b.set_params_flat(a.params_flat())
+    a.fit(x, y, epochs=1, batch_size=8)
+    b.fit(x, y, epochs=1, batch_size=8)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=2e-6)
+
+
+def test_cg_rnn_time_step_matches_full_sequence():
+    g = (NeuralNetConfiguration(seed=3, updater=Adam(1e-2), dtype="float32")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("l1", GravesLSTM(n_out=7, activation="tanh"), "in")
+         .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "l1")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(3, 5)))
+    net = ComputationGraph(g.build()).init()
+    x = R.normal(size=(4, 5, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(5)]
+    for t in range(5):
+        np.testing.assert_allclose(steps[t], full[:, t], atol=1e-5)
+    # state persists: re-feeding step 0 now differs from the fresh-state output
+    again = np.asarray(net.rnn_time_step(x[:, 0]))
+    assert not np.allclose(again, steps[0], atol=1e-5)
+    net.rnn_clear_previous_state()
+    fresh = np.asarray(net.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(fresh, steps[0], atol=1e-5)
+
+
+def test_cg_seq2seq_gradients_with_masking():
+    net = _seq2seq(dtype="float64", updater=Sgd(0.1))
+    x, y = _seq_data(n=4)
+    x, y = x.astype(np.float64), y.astype(np.float64)
+    fmask = np.ones((4, 6))
+    fmask[2, 4:] = 0.0
+    fmask[3, 2:] = 0.0
+    lmask = fmask.copy()
+    assert check_gradients(net, x, y, features_mask=fmask, labels_mask=lmask,
+                           subset=150, print_results=True)
+
+
+def test_cg_recurrent_gradients_plain():
+    g = (NeuralNetConfiguration(seed=9, updater=Sgd(0.1), dtype="float64")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("l1", LSTM(n_out=6, activation="tanh"), "in")
+         .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "l1")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(3, 4)))
+    net = ComputationGraph(g.build()).init()
+    x = R.normal(size=(3, 4, 3))
+    yi = (x.sum(-1) > 0).astype(int)
+    y = np.eye(2)[yi]
+    assert check_gradients(net, x, y, subset=150, print_results=True)

@@ -1,0 +1,213 @@
+"""Zoo part-2 models, dataset fetchers, iterator adapters, pretrained cache
+(reference zoo/model/{GoogLeNet,InceptionResNetV1,FaceNetNN4Small2,
+TextGenerationLSTM}.java, ZooModel.initPretrained :40-81,
+datasets/fetchers/*, datasets/iterator/*)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.datasets.fetchers import (Cifar10DataSetIterator,
+                                                  CurvesDataSetIterator,
+                                                  IrisDataSetIterator,
+                                                  load_cifar10, load_curves,
+                                                  load_iris)
+from deeplearning4j_tpu.datasets.iterators import (
+    EarlyTerminationDataSetIterator, ExistingDataSetIterator,
+    IteratorDataSetIterator, ListMultiDataSetIterator, MultiDataSet,
+    MultipleEpochsIterator, SamplingDataSetIterator)
+from deeplearning4j_tpu.models.pretrained import (adler32_of, fetch_cached,
+                                                  init_pretrained)
+from deeplearning4j_tpu.models.zoo_extra import (facenet_nn4_small2,
+                                                 googlenet,
+                                                 inception_resnet_v1,
+                                                 text_generation_lstm)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.util.serialization import write_model
+
+R = np.random.default_rng(21)
+
+
+# ------------------------------------------------------------------ zoo builds
+def _step_graph(net, h, w, n_classes, batch=2):
+    x = R.normal(size=(batch, h, w, 3)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[R.integers(0, n_classes, batch)]
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=1, batch_size=batch)
+    assert np.isfinite(s0)
+    out = np.asarray(net.output(x))
+    assert out.shape == (batch, n_classes)
+    return out
+
+
+def test_zoo_extra_models_build():
+    """Cheap structure checks: init + param counts (the full train-step
+    compiles are in the slow-marked tests below)."""
+    # reference GoogLeNet has ~7M params at 1000 classes
+    assert 5_000_000 < googlenet(n_classes=1000).init().num_params() < 9_000_000
+    assert facenet_nn4_small2(n_classes=5, height=64, width=64,
+                              embedding_size=32).init().num_params() > 1_000_000
+    assert inception_resnet_v1(n_classes=5, height=64, width=64,
+                               embedding_size=32, res_a=1, res_b=1,
+                               res_c=1).init().num_params() > 1_000_000
+
+
+@pytest.mark.slow
+def test_googlenet_steps():
+    net = googlenet(n_classes=7, height=64, width=64).init()
+    out = _step_graph(net, 64, 64, 7)
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-4)
+
+
+def test_facenet_nn4_small2_steps_and_l2_embeddings():
+    net = facenet_nn4_small2(n_classes=5, height=64, width=64,
+                             embedding_size=32).init()
+    _step_graph(net, 64, 64, 5)
+    # embeddings vertex is L2-normalized
+    acts = net.feed_forward(R.normal(size=(3, 64, 64, 3)).astype(np.float32))
+    emb = np.asarray(acts["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_inception_resnet_v1_steps():
+    net = inception_resnet_v1(n_classes=5, height=64, width=64,
+                              embedding_size=32,
+                              res_a=1, res_b=1, res_c=1).init()
+    _step_graph(net, 64, 64, 5)
+
+
+def test_text_generation_lstm_fits():
+    net = text_generation_lstm(vocab_size=12, max_length=16,
+                               hidden=24, tbptt_length=8).init()
+    ids = R.integers(0, 12, (8, 16))
+    x = np.eye(12, dtype=np.float32)[ids]
+    y = np.eye(12, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    s0 = float(net.score(x, y))
+    net.fit(x, y, epochs=5, batch_size=8)
+    assert float(net.score(x, y)) < s0
+
+
+# -------------------------------------------------------------------- datasets
+def test_iris_loads_and_trains():
+    x, y = load_iris()
+    assert x.shape == (150, 4) and y.shape == (150, 3)
+    assert y.sum() == 150
+    conf = (NeuralNetConfiguration(seed=3, updater=Adam(5e-2), dtype="float32")
+            .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(iterator=IrisDataSetIterator(batch_size=50), epochs=40)
+    assert net.evaluate(x, y).accuracy() > 0.9
+
+
+def test_cifar_synthetic_fallback_shapes():
+    x, y, synthetic = load_cifar10(cache_dir="/nonexistent-cache",
+                                   n_synthetic=64)
+    assert synthetic is True
+    assert x.shape == (64, 32, 32, 3) and y.shape == (64, 10)
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    it = Cifar10DataSetIterator(batch_size=32, cache_dir="/nonexistent-cache")
+    batches = list(it)
+    assert batches[0].features.shape[0] == 32
+
+
+def test_curves_generation():
+    x, y = load_curves(n=16, resolution=16)
+    assert x.shape == (16, 256)
+    np.testing.assert_array_equal(x, y)
+    assert x.max() <= 1.0 + 1e-6 and x.max() > 0.5   # strokes present
+    it = CurvesDataSetIterator(batch_size=8, num_examples=16, resolution=16)
+    assert sum(d.num_examples() for d in it) == 16
+
+
+# ----------------------------------------------------------- iterator adapters
+def _mini_iter(n=10, bs=2):
+    x = R.normal(size=(n, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[R.integers(0, 2, n)]
+    return ListDataSetIterator(features=x, labels=y, batch_size=bs)
+
+
+def test_multiple_epochs_iterator():
+    it = MultipleEpochsIterator(3, _mini_iter(10, 2))
+    assert len(list(it)) == 15
+
+
+def test_early_termination_iterator():
+    it = EarlyTerminationDataSetIterator(_mini_iter(10, 2), max_batches=2)
+    assert len(list(it)) == 2
+    it.reset()
+    assert len(list(it)) == 2
+    with pytest.raises(ValueError):
+        EarlyTerminationDataSetIterator(_mini_iter(), 0)
+
+
+def test_sampling_iterator():
+    ds = DataSet(R.normal(size=(20, 3)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[R.integers(0, 2, 20)])
+    it = SamplingDataSetIterator(ds, batch_size=8, n_batches=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert all(b.features.shape == (8, 3) for b in batches)
+
+
+def test_iterator_dataset_iterator_rebatches():
+    singles = [DataSet(R.normal(size=(1, 3)).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[[i % 2]])
+               for i in range(7)]
+    it = IteratorDataSetIterator(lambda: iter(singles), batch_size=3)
+    sizes = [d.num_examples() for d in it]
+    assert sizes == [3, 3, 1]
+
+
+def test_existing_and_multidataset_iterators():
+    mds = MultiDataSet(
+        features=[R.normal(size=(10, 4)).astype(np.float32),
+                  R.normal(size=(10, 2)).astype(np.float32)],
+        labels=[np.eye(2, dtype=np.float32)[R.integers(0, 2, 10)]])
+    it = ListMultiDataSetIterator(mds, batch_size=4)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+    assert len(batches[0].features) == 2
+    wrapped = ExistingDataSetIterator(batches)
+    assert len(list(wrapped)) == 3
+
+
+# ------------------------------------------------------------------ pretrained
+def test_pretrained_cache_checksum_and_load(tmp_path):
+    conf = (NeuralNetConfiguration(seed=9, updater=Adam(1e-3), dtype="float32")
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    src = str(tmp_path / "model.zip")
+    write_model(net, src)
+    csum = adler32_of(src)
+    cache = str(tmp_path / "cache")
+
+    # fetch + checksum hit
+    p = fetch_cached(src, checksum=csum, cache_dir=cache)
+    assert os.path.exists(p)
+    # wrong checksum -> IOError after one retry
+    with pytest.raises(IOError):
+        fetch_cached(src, checksum=csum + 1, cache_dir=str(tmp_path / "c2"))
+
+    fresh = MultiLayerNetwork(conf).init(seed=123)
+    assert not np.allclose(np.asarray(fresh.params_flat()),
+                           np.asarray(net.params_flat()))
+    init_pretrained(fresh, src, checksum=csum, cache_dir=cache)
+    np.testing.assert_allclose(np.asarray(fresh.params_flat()),
+                               np.asarray(net.params_flat()))
+
+    # architecture mismatch -> clear error
+    conf2 = (NeuralNetConfiguration(seed=9, updater=Adam(1e-3), dtype="float32")
+             .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                   OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+             .build())
+    with pytest.raises(ValueError, match="params"):
+        init_pretrained(MultiLayerNetwork(conf2).init(), src, checksum=csum,
+                        cache_dir=cache)

@@ -1,5 +1,7 @@
 from .kmeans import KMeansClustering
-from .tsne import Tsne
+from .trees import KDTree, QuadTree, SpTree
+from .tsne import BarnesHutTsne, Tsne
 from .vptree import VPTree
 
-__all__ = ["KMeansClustering", "Tsne", "VPTree"]
+__all__ = ["BarnesHutTsne", "KDTree", "KMeansClustering", "QuadTree",
+           "SpTree", "Tsne", "VPTree"]

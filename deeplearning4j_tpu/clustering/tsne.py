@@ -14,31 +14,38 @@ from typing import Optional
 import numpy as np
 
 
+def _beta_search_row(d2: np.ndarray, log_target: float, tol=1e-5,
+                     max_iter=50) -> np.ndarray:
+    """Bisection on precision beta for ONE point's squared distances until
+    the conditional distribution's entropy hits ``log_target``; returns the
+    normalized row (the classic van der Maaten x2p inner loop, shared by the
+    dense and sparse/Barnes-Hut paths)."""
+    beta, betamin, betamax = 1.0, -np.inf, np.inf
+    for _ in range(max_iter):
+        Pi = np.exp(-d2 * beta)
+        sum_p = max(Pi.sum(), 1e-12)
+        H = np.log(sum_p) + beta * (d2 * Pi).sum() / sum_p
+        diff = H - log_target
+        if abs(diff) < tol:
+            break
+        if diff > 0:
+            betamin = beta
+            beta = beta * 2 if betamax == np.inf else (beta + betamax) / 2
+        else:
+            betamax = beta
+            beta = beta / 2 if betamin == -np.inf else (beta + betamin) / 2
+    Pi = np.exp(-d2 * beta)       # row at the final beta
+    return Pi / max(Pi.sum(), 1e-12)
+
+
 def _binary_search_perplexity(D, perplexity, tol=1e-5, max_iter=50):
     """Per-point beta search for target perplexity (host-side, once)."""
     n = D.shape[0]
     P = np.zeros_like(D)
-    beta = np.ones(n)
     log_u = np.log(perplexity)
     for i in range(n):
-        betamin, betamax = -np.inf, np.inf
-        Di = np.delete(D[i], i)
-        for _ in range(max_iter):
-            Pi = np.exp(-Di * beta[i])
-            sum_p = max(Pi.sum(), 1e-12)
-            H = np.log(sum_p) + beta[i] * (Di * Pi).sum() / sum_p
-            diff = H - log_u
-            if abs(diff) < tol:
-                break
-            if diff > 0:
-                betamin = beta[i]
-                beta[i] = beta[i] * 2 if betamax == np.inf else (beta[i] + betamax) / 2
-            else:
-                betamax = beta[i]
-                beta[i] = beta[i] / 2 if betamin == -np.inf else (beta[i] + betamin) / 2
-        Pi = np.exp(-np.delete(D[i], i) * beta[i])
-        Pi /= max(Pi.sum(), 1e-12)
-        P[i, np.arange(n) != i] = Pi
+        P[i, np.arange(n) != i] = _beta_search_row(np.delete(D[i], i), log_u,
+                                                   tol, max_iter)
     return P
 
 
@@ -98,3 +105,90 @@ class Tsne:
             mom = 0.5 if i < 100 else self.momentum
             Y, vel, gains = step(Y, vel, gains, Pj * exag, lr, mom)
         return np.asarray(Y)
+
+
+class BarnesHutTsne(Tsne):
+    """O(N log N) Barnes-Hut t-SNE (reference plot/BarnesHutTsne.java:65 —
+    VPTree for the sparse input neighbourhoods, SpTree for the approximate
+    repulsive forces with accuracy knob ``theta``).
+
+    Host-side numpy by design: the tree walk is pointer-chasing the TPU can't
+    help with. For N <= ~10k the exact jitted ``Tsne`` is typically FASTER on
+    TPU (dense N^2 on the MXU); this class is for the larger-N regime and
+    reference parity.
+    """
+
+    def __init__(self, *args, theta: float = 0.5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.theta = theta
+
+    def _sparse_affinities(self, X):
+        from .vptree import VPTree
+        n = X.shape[0]
+        k = min(n - 1, max(3, int(3 * self.perplexity)))
+        tree = VPTree(X)
+        rows = np.empty((n, k), np.int64)
+        dists = np.empty((n, k), np.float64)
+        for i in range(n):
+            idxs, ds = tree.knn(X[i], k + 1)
+            pairs = [(j, d) for j, d in zip(idxs, ds) if j != i][:k]
+            rows[i] = [j for j, _ in pairs]
+            dists[i] = [d for _, d in pairs]
+        # per-point beta search on the k squared distances (shared helper
+        # with the dense path)
+        P = np.zeros((n, k))
+        target = np.log(min(self.perplexity, (n - 1) / 3.0))
+        for i in range(n):
+            P[i] = _beta_search_row(dists[i] ** 2, target)
+        # symmetrize the sparse matrix: COO (i, rows[i,j]) entries
+        src = np.repeat(np.arange(n), k)
+        dst = rows.reshape(-1)
+        val = P.reshape(-1)
+        # P_sym[i,j] = (P[i,j] + P[j,i]) / (2n) over the union of supports
+        both = {}
+        for s, d, v in zip(src, dst, val):
+            both[(s, d)] = both.get((s, d), 0.0) + v
+            both[(d, s)] = both.get((d, s), 0.0) + 0.0
+        coo_i = np.fromiter((ij[0] for ij in both), np.int64, len(both))
+        coo_j = np.fromiter((ij[1] for ij in both), np.int64, len(both))
+        coo_v = np.fromiter(
+            ((both[(i, j)] + both.get((j, i), 0.0)) / (2.0 * n)
+             for i, j in zip(coo_i, coo_j)), np.float64, len(both))
+        coo_v = np.maximum(coo_v, 1e-12)
+        return coo_i, coo_j, coo_v
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        from .trees import SpTree
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        ci, cj, cv = self._sparse_affinities(X)
+        rng = np.random.default_rng(self.seed)
+        Y = rng.normal(0, 1e-4, (n, self.n_components))
+        vel = np.zeros_like(Y)
+        gains = np.ones_like(Y)
+        lr = self.learning_rate or max(n / self.early_exaggeration / 4.0, 10.0)
+        for it in range(self.n_iter):
+            exag = self.early_exaggeration if it < 100 else 1.0
+            mom = 0.5 if it < 100 else self.momentum
+            # attractive: sum_j p_ij q_ij (y_i - y_j), vectorized over COO
+            diff = Y[ci] - Y[cj]
+            q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            w = (exag * cv) * q
+            attr = np.zeros_like(Y)
+            np.add.at(attr, ci, w[:, None] * diff)
+            # repulsive via Barnes-Hut tree (reference computeNonEdgeForces)
+            tree = SpTree.build(Y)
+            rep = np.zeros_like(Y)
+            z = 0.0
+            for i in range(n):
+                neg = np.zeros(self.n_components)
+                z += tree.compute_non_edge_forces(Y[i], self.theta, neg)
+                rep[i] = neg
+            g = 4.0 * (attr - rep / max(z, 1e-12))
+            same_sign = (g * vel) > 0
+            gains = np.clip(np.where(same_sign, gains * 0.8, gains + 0.2),
+                            0.01, None)
+            vel = mom * vel - lr * gains * g
+            Y = Y + vel
+            Y -= Y.mean(0)
+        return Y

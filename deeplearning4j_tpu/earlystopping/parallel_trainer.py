@@ -1,0 +1,48 @@
+"""EarlyStoppingParallelTrainer: early stopping over data-parallel fitting.
+
+Reference: parallelism/EarlyStoppingParallelTrainer.java (373 LoC) — the
+EarlyStoppingTrainer loop where each epoch's fitting runs through
+ParallelWrapper instead of the single-device solver. Here that is literally
+the composition: same termination/saver/score machinery, epochs delegated to
+``ParallelWrapper.fit`` over the mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..parallel.data_parallel import ParallelWrapper
+from .early_stopping import (EarlyStoppingConfiguration, EarlyStoppingResult,
+                             EarlyStoppingTrainer)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
+                 *, mesh=None, workers: Optional[int] = None,
+                 averaging_frequency: int = 1,
+                 training_mode: str = "shared_gradients",
+                 average_updaters: bool = True,
+                 gradient_accumulator=None):
+        super().__init__(config, net, train_iterator)
+        self.wrapper = ParallelWrapper(
+            net, mesh=mesh, workers=workers,
+            averaging_frequency=averaging_frequency,
+            training_mode=training_mode, average_updaters=average_updaters,
+            gradient_accumulator=gradient_accumulator)
+        # route the epoch fits through the wrapper: the base trainer calls
+        # net.fit(iterator=..., epochs=1); shim it (reference wraps the model
+        # in ParallelWrapper and drives fit() on it, :112-140)
+        self._orig_fit = net.fit
+
+    def fit(self) -> EarlyStoppingResult:
+        net = self.net
+        wrapper = self.wrapper
+
+        def pw_fit(data=None, labels=None, *, epochs=1, iterator=None, **kw):
+            wrapper.fit(iterator, epochs=epochs)
+            return net
+
+        net.fit = pw_fit
+        try:
+            return super().fit()
+        finally:
+            net.fit = self._orig_fit

@@ -1,0 +1,5 @@
+from .deepwalk import DeepWalk
+from .graph import Graph, RandomWalkIterator, WeightedRandomWalkIterator
+
+__all__ = ["DeepWalk", "Graph", "RandomWalkIterator",
+           "WeightedRandomWalkIterator"]

@@ -226,9 +226,8 @@ def _facenet_inception(g, name, inp, b1, b3r, b3, b5r, b5, pool_proj,
     if b5r:
         x = _conv_bn_ir(g, f"{name}-5x5r", inp, b5r, (1, 1))
         ends.append(_conv_bn_ir(g, f"{name}-5x5", x, b5, (5, 5), stride))
-    pool_stride = stride if stride != (1, 1) else (1, 1)
     g.add_layer(f"{name}-pool", SubsamplingLayer(
-        pooling_type="max", kernel_size=(3, 3), stride=pool_stride,
+        pooling_type="max", kernel_size=(3, 3), stride=stride,
         convolution_mode="same"), inp)
     if pool_proj:
         ends.append(_conv_bn_ir(g, f"{name}-poolproj", f"{name}-pool",

@@ -8,6 +8,9 @@ from .serde import (read_binary_word_vectors, read_word_vectors,
 from .tokenizer import (CommonPreprocessor, DefaultTokenizerFactory,
                         LowCasePreProcessor, NGramTokenizerFactory,
                         TokenizerFactory)
+from .vectorizers import (BagOfWordsVectorizer, CollectionDocumentIterator,
+                          DocumentIterator, FileDocumentIterator,
+                          TfidfVectorizer)
 from .vocab import VocabCache, VocabWord
 from .word2vec import ParagraphVectors, Word2Vec
 

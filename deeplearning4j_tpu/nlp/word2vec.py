@@ -39,17 +39,21 @@ class Word2Vec(SequenceVectors):
 
 
 class ParagraphVectors(SequenceVectors):
-    """PV-DBOW: each document's label vector predicts the document's words
-    (reference learning/impl/sequence/DBOW.java); optional simultaneous word
-    training (``train_words``)."""
+    """Doc2vec. PV-DBOW (default): each document's label vector predicts the
+    document's words (reference learning/impl/sequence/DBOW.java). PV-DM
+    (``dm=True``): the doc vector is averaged WITH the context-window word
+    vectors to predict the target word (reference DM.java — mean variant).
+    PV-DM rides the engine's CBOW step over a combined [words ; docs]
+    embedding table, so the update stays scatter-add-only."""
 
     def __init__(self, *, iterate: Optional[LabelAwareIterator] = None,
                  tokenizer_factory: Optional[TokenizerFactory] = None,
-                 train_words: bool = True, **kwargs):
+                 train_words: bool = True, dm: bool = False, **kwargs):
         super().__init__(**kwargs)
         self.iterate = iterate
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self.train_words = train_words
+        self.dm = dm
         self.doc_labels: List[str] = []
         self.doc_vectors: Optional[np.ndarray] = None
 
@@ -79,6 +83,8 @@ class ParagraphVectors(SequenceVectors):
             self.syn1neg = np.zeros((V, D), np.float32)
             if self._step is None:
                 self._step = self._build_step()
+        if self.dm:
+            return self._fit_dm(docs_tok)
         # 2) PV-DBOW: doc vector predicts its words against syn1neg
         rng = np.random.default_rng(self.seed + 1)
         D = self.layer_size
@@ -109,6 +115,59 @@ class ParagraphVectors(SequenceVectors):
         self.syn1neg = np.asarray(syn1)
         return self
 
+    def _fit_dm(self, docs_tok):
+        """PV-DM mean variant over a combined [V words ; n docs] table: each
+        training example's 'context set' = window words + the doc's row
+        (index V+di); the engine's CBOW step averages and scatter-updates the
+        combined table (reference DM.java semantics, TPU-batched)."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(self.seed + 2)
+        V, D = len(self.vocab), self.layer_size
+        n_docs = len(docs_tok)
+        dvec = ((rng.random((n_docs, D)) - 0.5) / D).astype(np.float32)
+        combined = jnp.asarray(np.vstack([np.asarray(self.syn0), dvec]))
+        # targets/negatives are always word indices < V, so syn1 needs no
+        # doc rows
+        syn1 = jnp.asarray(self.syn1neg)
+        table = self.vocab.unigram_table()
+        C = 2 * self.window + 1          # window words + doc row
+        cbow_step = SequenceVectors(
+            layer_size=D, window=self.window, negative=self.negative,
+            learning_algorithm="cbow")._build_step()
+        idx_docs = [np.asarray([self.vocab.index_of(w) for w in toks
+                                if w in self.vocab], np.int32)
+                    for _, toks in docs_tok]
+        # training examples are epoch-invariant — build once, permute per epoch
+        centers, mask_lens, targets = [], [], []
+        for di, idxs in enumerate(idx_docs):
+            for t in range(len(idxs)):
+                lo, hi = max(0, t - self.window), min(len(idxs), t + self.window + 1)
+                ctx = [idxs[j] for j in range(lo, hi) if j != t]
+                centers.append(ctx + [V + di] + [0] * (C - len(ctx) - 1))
+                mask_lens.append(len(ctx) + 1)
+                targets.append(idxs[t])
+        ctr_all = np.asarray(centers, np.int32)
+        msk_all = (np.arange(C)[None, :]
+                   < np.asarray(mask_lens)[:, None]).astype(np.float32)
+        tgt_all = np.asarray(targets, np.int32)
+        for epoch in range(max(1, self.epochs)):
+            order = rng.permutation(len(ctr_all))
+            lr = max(self.min_learning_rate,
+                     self.learning_rate * (1 - epoch / max(1, self.epochs)))
+            ctr, msk, tgt = ctr_all[order], msk_all[order], tgt_all[order]
+            for s in range(0, len(ctr), self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                negs = table[rng.integers(0, len(table),
+                                          (len(tgt[sl]), self.negative))]
+                combined, syn1, _ = cbow_step(
+                    combined, syn1, jnp.asarray(ctr[sl]), jnp.asarray(tgt[sl]),
+                    jnp.asarray(negs), lr, jnp.asarray(msk[sl]))
+        combined = np.asarray(combined)
+        self.syn0 = combined[:V]
+        self.doc_vectors = combined[V:]
+        self.syn1neg = np.asarray(syn1)
+        return self
+
     def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
         try:
             return self.doc_vectors[self.doc_labels.index(label)]
@@ -118,7 +177,9 @@ class ParagraphVectors(SequenceVectors):
     def infer_vector(self, text: str, steps: int = 20,
                      learning_rate: Optional[float] = None) -> np.ndarray:
         """Gradient-fit a fresh doc vector against frozen weights (reference
-        ParagraphVectors.inferVector)."""
+        ParagraphVectors.inferVector), using the configured algorithm:
+        DBOW (doc vector alone predicts each word) or DM (doc vector averaged
+        with frozen context word vectors predicts each target)."""
         import jax
         import jax.numpy as jnp
         toks = self.tokenizer_factory.create(text).get_tokens()
@@ -133,16 +194,40 @@ class ParagraphVectors(SequenceVectors):
         table = self.vocab.unigram_table()
         lr = learning_rate or self.learning_rate
 
-        @jax.jit
-        def one(v, words, negs, lr):
-            def lf(v):
-                u_pos = syn1[words]
-                u_neg = syn1[negs]
-                pos = jax.nn.softplus(-(u_pos @ v))
-                neg = jax.nn.softplus(u_neg @ v)
-                return jnp.mean(pos) + jnp.mean(jnp.sum(neg, axis=-1))
-            g = jax.grad(lf)(v)
-            return v - lr * g
+        if self.dm:
+            W = self.window
+            ctx_mean = np.zeros((len(widx), self.layer_size), np.float32)
+            n_ctx = np.zeros((len(widx), 1), np.float32)
+            s0 = np.asarray(self.syn0)
+            for t in range(len(widx)):
+                lo, hi = max(0, t - W), min(len(widx), t + W + 1)
+                ctx = [widx[j] for j in range(lo, hi) if j != t]
+                if ctx:
+                    ctx_mean[t] = s0[ctx].sum(0)
+                n_ctx[t, 0] = len(ctx)
+            ctx_sum = jnp.asarray(ctx_mean)
+            denom = jnp.asarray(n_ctx + 1.0)
+
+            @jax.jit
+            def one(v, words, negs, lr):
+                def lf(v):
+                    mean_vec = (ctx_sum + v[None, :]) / denom  # [T, D]
+                    pos = jax.nn.softplus(-jnp.sum(mean_vec * syn1[words], -1))
+                    neg = jax.nn.softplus(
+                        jnp.einsum("td,tkd->tk", mean_vec, syn1[negs]))
+                    return jnp.mean(pos) + jnp.mean(jnp.sum(neg, axis=-1))
+                return v - lr * jax.grad(lf)(v)
+        else:
+            @jax.jit
+            def one(v, words, negs, lr):
+                def lf(v):
+                    u_pos = syn1[words]
+                    u_neg = syn1[negs]
+                    pos = jax.nn.softplus(-(u_pos @ v))
+                    neg = jax.nn.softplus(u_neg @ v)
+                    return jnp.mean(pos) + jnp.mean(jnp.sum(neg, axis=-1))
+                g = jax.grad(lf)(v)
+                return v - lr * g
 
         for s in range(steps):
             negs = table[rng.integers(0, len(table), (len(widx), self.negative))]

@@ -37,6 +37,10 @@ class MultiLayerConfiguration:
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
     updater: Optional[Any] = None         # global updater (layers may override)
+    # reference nn/api/OptimizationAlgorithm.java:27 — STOCHASTIC_GRADIENT_DESCENT,
+    # LINE_GRADIENT_DESCENT, CONJUGATE_GRADIENT, LBFGS
+    optimization_algorithm: str = "sgd"
+    max_num_line_search_iterations: int = 5
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -69,7 +73,8 @@ class NeuralNetConfiguration:
                  bias_learning_rate: Optional[float] = None,
                  gradient_normalization: Optional[str] = None,
                  gradient_normalization_threshold: float = 1.0,
-                 dtype: str = "float32", **workspace_noops):
+                 dtype: str = "float32", optimization_algorithm: str = "sgd",
+                 max_num_line_search_iterations: int = 5, **workspace_noops):
         if updater is None:
             updater = Sgd(learning_rate=learning_rate if learning_rate is not None else 0.1)
         elif isinstance(updater, str):
@@ -90,6 +95,8 @@ class NeuralNetConfiguration:
         self.gradient_normalization = gradient_normalization
         self.gradient_normalization_threshold = gradient_normalization_threshold
         self.dtype = dtype
+        self.optimization_algorithm = optimization_algorithm.lower()
+        self.max_num_line_search_iterations = max_num_line_search_iterations
 
     # --- cascade (reference :604-608): fill None fields from globals ---
     def _cascade(self, layer):
@@ -192,7 +199,9 @@ class ListBuilder:
             tbptt_bwd_length=self._tbptt_bwd, pretrain=self._pretrain,
             gradient_normalization=nc.gradient_normalization,
             gradient_normalization_threshold=nc.gradient_normalization_threshold,
-            updater=nc.updater)
+            updater=nc.updater,
+            optimization_algorithm=nc.optimization_algorithm,
+            max_num_line_search_iterations=nc.max_num_line_search_iterations)
 
 
 def _infer_n_in(layer, itype):

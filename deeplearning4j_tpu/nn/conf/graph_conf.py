@@ -37,6 +37,9 @@ class ComputationGraphConfiguration:
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
     updater: Optional[Any] = None
+    # reference nn/api/OptimizationAlgorithm.java:27 (see config.py)
+    optimization_algorithm: str = "sgd"
+    max_num_line_search_iterations: int = 5
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -173,4 +176,6 @@ class GraphBuilder:
             tbptt_fwd_length=self._tbptt_fwd, tbptt_bwd_length=self._tbptt_bwd,
             gradient_normalization=nc.gradient_normalization,
             gradient_normalization_threshold=nc.gradient_normalization_threshold,
-            updater=nc.updater)
+            updater=nc.updater,
+            optimization_algorithm=nc.optimization_algorithm,
+            max_num_line_search_iterations=nc.max_num_line_search_iterations)

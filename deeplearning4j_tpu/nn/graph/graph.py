@@ -316,15 +316,34 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------ eval
     def evaluate(self, iterator_or_x, y=None):
+        """Per-output classification evaluation. Single-output graphs return
+        ONE Evaluation (reference ComputationGraph.evaluate :2784);
+        multi-output graphs return a list of Evaluations, one per network
+        output in declaration order."""
         from ...eval.evaluation import Evaluation
-        e = Evaluation()
+        n_out = len(self.conf.network_outputs)
+        evals = [Evaluation() for _ in range(n_out)]
+
+        def eval_batch(features, labels, lmask):
+            outs = self.output(*_as_list(features))
+            outs = outs if isinstance(outs, list) else [outs]
+            labels_l = _as_list(labels)
+            if len(labels_l) != n_out:
+                raise ValueError(
+                    f"evaluate() got {len(labels_l)} label array(s) for a "
+                    f"{n_out}-output graph ({self.conf.network_outputs}); "
+                    f"pass one per output (None to skip an output)")
+            masks_l = _as_list(lmask) if lmask is not None else [None] * n_out
+            for e, o, l, m in zip(evals, outs, labels_l, masks_l):
+                if l is not None:
+                    e.eval(l, np.asarray(o), mask=m)
+
         if y is not None:
-            e.eval(y, np.asarray(self.output(iterator_or_x)))
-            return e
-        for ds in iterator_or_x:
-            out = self.output(*_as_list(ds.features))
-            e.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
-        return e
+            eval_batch(iterator_or_x, y, None)
+        else:
+            for ds in iterator_or_x:
+                eval_batch(ds.features, ds.labels, ds.labels_mask)
+        return evals[0] if n_out == 1 else evals
 
     def clone(self) -> "ComputationGraph":
         import copy

@@ -1,0 +1,95 @@
+"""Self-attention layer (net-new, beyond reference parity).
+
+The reference's sequence story is LSTM-only (SURVEY.md §5.7 explicitly notes
+no attention exists). This layer adds the modern long-context primitive in
+the framework's own layer SPI: multi-head softmax self-attention over
+[B,T,F], mask-aware, causal-optional — single-device math in
+parallel/ring_attention.attention, and the time axis is mesh-shardable via
+parallel/ring_attention.ring_attention_sharded (sequence/context
+parallelism over ICI).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, ClassVar, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+from ..inputs import InputTypeRecurrent
+from .base import LayerConf, maybe_dropout, resolve_ff_size
+
+
+@register
+@dataclass
+class SelfAttentionLayer(LayerConf):
+    """Multi-head self-attention, [B,T,F] -> [B,T,n_out].
+
+    ``n_out`` must be divisible by ``n_heads``. With ``causal`` each position
+    attends only to itself and earlier steps. A [B,T] feature mask excludes
+    padded timesteps as attention KEYS (queries at masked positions produce
+    outputs that downstream masked losses ignore, matching the framework's
+    masking convention).
+    """
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 4
+    causal: bool = False
+    project_out: bool = True
+
+    param_order: ClassVar[Tuple[str, ...]] = ("Wq", "Wk", "Wv", "Wo", "b")
+    weight_param_names: ClassVar[Tuple[str, ...]] = ("Wq", "Wk", "Wv", "Wo")
+    expected_input: ClassVar[str] = "rnn"
+    accepts_mask: ClassVar[bool] = True
+
+    def output_type(self, itype):
+        t = itype.timestep_length if isinstance(itype, InputTypeRecurrent) else -1
+        return InputTypeRecurrent(self.n_out, t)
+
+    def init(self, rng, itype, dtype):
+        n_in = self.n_in or resolve_ff_size(itype)
+        self.n_in = n_in
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out={self.n_out} must be divisible by "
+                             f"n_heads={self.n_heads}")
+        ks = jax.random.split(rng, 4)
+        d = self.n_out
+        params = {
+            "Wq": self._winit(ks[0], (n_in, d), n_in, d, dtype),
+            "Wk": self._winit(ks[1], (n_in, d), n_in, d, dtype),
+            "Wv": self._winit(ks[2], (n_in, d), n_in, d, dtype),
+            "Wo": self._winit(ks[3], (d, d), d, d, dtype),
+            "b": self._binit((d,), dtype),
+        }
+        return params, {}
+
+    def _heads(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.n_heads, -1).transpose(0, 2, 1, 3)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from ...parallel.ring_attention import attention
+        x = maybe_dropout(x, self.dropout, rng, train)
+        q = self._heads(x @ params["Wq"])
+        k = self._heads(x @ params["Wk"])
+        v = self._heads(x @ params["Wv"])
+        if mask is not None:
+            # exclude padded timesteps as keys: zero their values and push
+            # their scores to -inf via a large negative bias on k
+            key_mask = jnp.asarray(mask, x.dtype)[:, None, None, :]  # [B,1,1,T]
+            scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], x.dtype))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            s = jnp.where(key_mask > 0, s, -1e30)
+            if self.causal:
+                T = s.shape[-1]
+                s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        else:
+            out = attention(q, k, v, causal=self.causal)
+        B, H, T, Dh = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+        if self.project_out:
+            out = out @ params["Wo"] + params["b"]
+        return self.act(out), state

@@ -1,0 +1,237 @@
+"""Second-order / line-search solvers: LineGradientDescent, ConjugateGradient,
+LBFGS + BackTrackLineSearch.
+
+Reference: optimize/solvers/{LineGradientDescent, ConjugateGradient,
+LBFGS, BackTrackLineSearch}.java and BaseOptimizer.java (gradientAndScore
+:172-190; the Solver dispatches on nn/api/OptimizationAlgorithm.java:27).
+
+TPU-first shape: the loss is ONE jitted function of the flat parameter
+vector (flat-param contract, SURVEY.md §0); each outer iteration evaluates
+value+grad in one XLA call and the line search re-evaluates the same compiled
+program at trial points — no per-layer host orchestration. Direction/history
+state (CG beta, L-BFGS (s,y) pairs) lives host-side between minibatches,
+mirroring the reference's per-Solver optimizer instances.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Optional, Tuple
+
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (reference BackTrackLineSearch.java: step halving
+    with sufficient-decrease c1=1e-4, maxIterations from conf)."""
+
+    def __init__(self, c1: float = 1e-4, rho: float = 0.5,
+                 max_iterations: int = 5, min_step: float = 1e-12):
+        self.c1 = c1
+        self.rho = rho
+        self.max_iterations = max_iterations
+        self.min_step = min_step
+
+    def search(self, f: Callable[[np.ndarray], float], x0: np.ndarray,
+               direction: np.ndarray, f0: float, g0: np.ndarray,
+               initial_step: float = 1.0) -> Tuple[float, float]:
+        """Returns (step, f_at_step). Falls back to step=0 when no decrease
+        is found (caller keeps the old params — reference returns 0 score
+        improvement)."""
+        slope = float(g0 @ direction)
+        if slope >= 0:
+            # not a descent direction (reference logs + bails)
+            return 0.0, f0
+        step = initial_step
+        for _ in range(self.max_iterations):
+            fx = float(f(x0 + step * direction))
+            if np.isfinite(fx) and fx <= f0 + self.c1 * step * slope:
+                return step, fx
+            step *= self.rho
+            if step < self.min_step:
+                break
+        return 0.0, f0
+
+
+class _FlatProblem:
+    """loss/grad of the flat parameter vector for one (x, y) batch —
+    built once per network, jit-compiled once."""
+
+    def __init__(self, net):
+        import jax
+        import jax.numpy as jnp
+        from ..util.gradcheck import _named_flat
+
+        self.net = net
+        per_layer = [_named_flat(p, layer.param_order)
+                     for layer, p in zip(net.layers, net.params)]
+        self._sizes = [s for _, _, s in per_layer]
+        self._unfs = [u for _, u, _ in per_layer]
+
+        def unflatten(flat):
+            params, off = [], 0
+            for unf, size in zip(self._unfs, self._sizes):
+                params.append(unf(flat[off:off + size]))
+                off += size
+            return tuple(params)
+
+        def loss(flat, state, it, x, y, lmask=None, fmask=None):
+            # iteration-folded rng so dropout masks vary across outer
+            # iterations (the SGD path folds iteration_count the same way)
+            l, new_state = net.loss_fn(unflatten(flat), state, x, y,
+                                       train=True,
+                                       labels_mask=lmask, features_mask=fmask,
+                                       rng=jax.random.fold_in(
+                                           jax.random.PRNGKey(0), it))
+            return l, new_state
+
+        self._vg = jax.jit(jax.value_and_grad(loss, has_aux=True))
+        self._loss = jax.jit(lambda *a, **k: loss(*a, **k)[0])
+        self.unflatten = unflatten
+        self._it = 0
+
+    def flat0(self) -> np.ndarray:
+        return np.asarray(self.net.params_flat(), np.float64)
+
+    def value_and_grad(self, flat, x, y, lmask=None, fmask=None):
+        import jax.numpy as jnp
+        (l, new_state), g = self._vg(flat, self.net.state,
+                                     jnp.asarray(self._it, jnp.int32), x, y,
+                                     lmask=lmask, fmask=fmask)
+        return float(l), np.asarray(g), new_state
+
+    def loss_only(self, x, y, lmask=None, fmask=None):
+        import jax.numpy as jnp
+        it = jnp.asarray(self._it, jnp.int32)
+        return lambda flat: self._loss(flat, self.net.state, it, x, y,
+                                       lmask=lmask, fmask=fmask)
+
+    def commit(self, flat, new_state=None):
+        self.net.set_params_flat(flat)
+        if new_state is not None:
+            self.net.state = new_state
+
+
+class SecondOrderOptimizer:
+    """One outer iteration per minibatch: compute direction, line-search,
+    commit. Subclasses define ``direction``."""
+
+    name = "base"
+
+    def __init__(self, net, max_line_search_iterations: int = 5):
+        self.problem = _FlatProblem(net)
+        self.line_search = BackTrackLineSearch(
+            max_iterations=max_line_search_iterations)
+        self._prev_g: Optional[np.ndarray] = None
+        self._prev_d: Optional[np.ndarray] = None
+
+    def direction(self, g: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, x, y, lmask=None, fmask=None) -> float:
+        """One optimize() outer iteration (reference BaseOptimizer.optimize).
+        Returns the post-step score."""
+        flat = self.problem.flat0()
+        f0, g, new_state = self.problem.value_and_grad(flat, x, y, lmask, fmask)
+        d = self.direction(g)
+        # normalize overly large directions (reference GradientAdjustment /
+        # step max); keeps line search in a sane range
+        dn = np.linalg.norm(d)
+        if dn > 1e3:
+            d = d * (1e3 / dn)
+        step, fx = self.line_search.search(
+            self.problem.loss_only(x, y, lmask, fmask), flat, d, f0, g)
+        self.problem._it += 1
+        if step > 0:
+            new_flat = flat + step * d
+            self._record(flat, g, new_flat, step)
+            self.problem.commit(new_flat, new_state)
+            return fx
+        self._record(flat, g, flat, 0.0)
+        self.problem.commit(flat, new_state)
+        return f0
+
+    def _record(self, flat, g, new_flat, step):
+        self._prev_g = g
+        self._prev_d = None if step == 0 else (new_flat - flat) / step
+
+
+class LineGradientDescent(SecondOrderOptimizer):
+    """Steepest descent + line search (reference LineGradientDescent.java)."""
+
+    name = "line_gradient_descent"
+
+    def direction(self, g):
+        return -g
+
+
+class ConjugateGradient(SecondOrderOptimizer):
+    """Nonlinear CG with Polak-Ribiere beta and automatic restart
+    (reference ConjugateGradient.java)."""
+
+    name = "conjugate_gradient"
+
+    def direction(self, g):
+        if self._prev_g is None or self._prev_d is None:
+            return -g
+        denom = float(self._prev_g @ self._prev_g)
+        beta = max(0.0, float(g @ (g - self._prev_g)) / max(denom, 1e-12))
+        return -g + beta * self._prev_d
+
+
+class LBFGS(SecondOrderOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference LBFGS.java,
+    default history m=4)."""
+
+    name = "lbfgs"
+
+    def __init__(self, net, max_line_search_iterations: int = 5, m: int = 4):
+        super().__init__(net, max_line_search_iterations)
+        self.m = m
+        self._hist: Deque[Tuple[np.ndarray, np.ndarray]] = deque(maxlen=m)
+        self._last_flat: Optional[np.ndarray] = None
+        self._last_g: Optional[np.ndarray] = None
+
+    def direction(self, g):
+        q = g.copy()
+        alphas = []
+        for s, yv in reversed(self._hist):
+            rho = 1.0 / max(float(yv @ s), 1e-12)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, yv))
+            q -= a * yv
+        if self._hist:
+            s, yv = self._hist[-1]
+            gamma = float(s @ yv) / max(float(yv @ yv), 1e-12)
+            q *= gamma
+        for a, rho, s, yv in reversed(alphas):
+            b = rho * float(yv @ q)
+            q += (a - b) * s
+        return -q
+
+    def _record(self, flat, g, new_flat, step):
+        # (s, y) pair from the PREVIOUS accepted point to this one:
+        # s = x_k - x_{k-1}, y = g_k - g_{k-1}
+        if self._last_flat is not None:
+            s = flat - self._last_flat
+            yv = g - self._last_g
+            if float(s @ yv) > 1e-10:     # curvature condition
+                self._hist.append((s, yv))
+        self._last_flat = flat.copy()
+        self._last_g = g.copy()
+        super()._record(flat, g, new_flat, step)
+
+
+_ALGOS = {
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+def make_optimizer(name: str, net, max_line_search_iterations: int = 5):
+    try:
+        cls = _ALGOS[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown optimization algorithm {name!r}; "
+                         f"available: sgd, {', '.join(sorted(_ALGOS))}")
+    return cls(net, max_line_search_iterations)

@@ -133,6 +133,20 @@ class Solver:
         if net.params is None:
             net.init()
         tbptt = net.conf.backprop_type == "tbptt"
+        algo = getattr(net.conf, "optimization_algorithm", "sgd")
+        if algo in ("sgd", "stochastic_gradient_descent"):
+            algo = "sgd"      # reference enum name STOCHASTIC_GRADIENT_DESCENT
+        second_order = None
+        if algo and algo != "sgd":
+            if tbptt:
+                raise ValueError("tBPTT is an SGD-path feature; second-order "
+                                 "solvers run full-sequence batches")
+            if not hasattr(self, "_second_order") or self._second_order is None:
+                from .second_order import make_optimizer
+                self._second_order = make_optimizer(
+                    algo, net,
+                    getattr(net.conf, "max_num_line_search_iterations", 5))
+            second_order = self._second_order
         if iterator is None:
             if dataset is not None:
                 iterator = ListDataSetIterator([dataset])
@@ -159,7 +173,11 @@ class Solver:
                 y = _cast_any(ds.labels, dtype)
                 lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)
                 fmask = None if ds.features_mask is None else _cast_any(ds.features_mask, dtype)
-                if tbptt:
+                if second_order is not None:
+                    # one outer line-search iteration per minibatch (reference
+                    # Solver dispatch, optimize/Solver.java:69-78)
+                    loss = second_order.step(x, y, lmask, fmask)
+                elif tbptt:
                     loss = self._fit_tbptt_batch(x, y, lmask, fmask, base_rng)
                 else:
                     step_fn = self._get_step(lmask is not None, fmask is not None)

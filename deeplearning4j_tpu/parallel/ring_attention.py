@@ -1,0 +1,119 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+NET-NEW capability beyond reference parity (SURVEY.md §5.7 records that the
+reference has NO attention and no context parallelism; the survey directs
+that the sequence dimension be a shardable mesh axis). This module provides
+the TPU-idiomatic long-context primitive: the sequence is sharded across a
+``seq`` mesh axis, each device holds one Q/K/V block, and K/V blocks rotate
+around the ring via ``jax.lax.ppermute`` while a numerically-stable online
+softmax (running max + rescaled partial sums, the FlashAttention recurrence)
+accumulates the output — peak memory per device is O(T/n) instead of O(T),
+and the permute traffic rides ICI neighbor links.
+
+Public surface:
+- ``attention(q, k, v, causal=...)`` — plain single-device reference.
+- ``ring_attention_sharded(mesh, axis, ...)`` — builds the shard_map'd
+  long-context attention over the mesh; output is bitwise-comparable (up to
+  float tolerance) with the single-device version on the gathered sequence.
+- ``SelfAttentionLayer`` (nn/layers/attention.py) uses ``attention`` on one
+  chip; swap in the sharded variant for long sequences.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_update(acc, m, l, q, k, v, scale, mask=None):
+    """One block of the online-softmax recurrence (FlashAttention-style):
+    q [B,H,Tq,D], k/v [B,H,Tk,D]; carry (acc [B,H,Tq,D], m [B,H,Tq],
+    l [B,H,Tq])."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * jnp.asarray(scale, q.dtype)
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # exp(-inf - -inf) guards: fully-masked blocks contribute nothing
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m[..., None], -jnp.inf))
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return acc_new, m_new, l_new
+
+
+def attention(q, k, v, *, causal: bool = False,
+              scale: Optional[float] = None):
+    """Plain softmax attention, [B,H,T,D] in/out (single-device reference
+    semantics for the ring version)."""
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * jnp.asarray(scale, q.dtype)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ring_body(q, k0, v0, axis, n, causal, scale, t_local):
+    """Executes on each device inside shard_map: local q stays, k/v rotate
+    n-1 hops; online softmax accumulates across blocks."""
+    idx = jax.lax.axis_index(axis)
+    B, H, Tq, D = q.shape
+
+    def step(j, carry):
+        acc, m, l, k, v = carry
+        src = (idx - j) % n          # which device's k/v block we hold now
+        mask = None
+        if causal:
+            q_pos = idx * t_local + jnp.arange(Tq)[:, None]       # [Tq,1]
+            k_pos = src * t_local + jnp.arange(k.shape[2])[None]  # [1,Tk]
+            mask = (k_pos <= q_pos)[None, None]                   # [1,1,Tq,Tk]
+        acc, m, l = _block_update(acc, m, l, q, k, v, scale, mask)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k = jax.lax.ppermute(k, axis, perm)
+        v = jax.lax.ppermute(v, axis, perm)
+        return acc, m, l, k, v
+
+    acc = jnp.zeros(q.shape, q.dtype)
+    m = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
+    l = jnp.zeros((B, H, Tq), q.dtype)
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc, m, l, k0, v0))
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(mesh: Mesh, axis: str = "seq", *,
+                           causal: bool = False,
+                           scale: Optional[float] = None):
+    """Build a jitted ring-attention fn over ``mesh``: inputs [B,H,T,D] with
+    T sharded on ``axis`` (T must divide evenly); output sharded the same.
+
+        fn = ring_attention_sharded(mesh, "seq", causal=True)
+        out = fn(q, k, v)     # q,k,v sharded NamedSharding(mesh, P(None,None,"seq"))
+    """
+    n = int(mesh.shape[axis])
+
+    def fn(q, k, v):
+        sc = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
+        t_local = q.shape[2] // n
+        body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
+                                 scale=sc, t_local=t_local)
+        spec = P(None, None, axis, None)
+        sharded = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)
+        return sharded(q, k, v)
+
+    return jax.jit(fn)
+
+
+def sequence_sharding(mesh: Mesh, axis: str = "seq") -> NamedSharding:
+    """Sharding for [B,H,T,D] tensors with the time axis on ``axis``."""
+    return NamedSharding(mesh, P(None, None, axis, None))

@@ -1,0 +1,146 @@
+"""Checkpoint/restart orchestration.
+
+Reference: the early-stopping savers (earlystopping/saver/
+LocalFileModelSaver.java) cover best/latest-per-epoch; this module adds the
+periodic-checkpoint + resume loop the reference delegates to Spark's driver
+state (SURVEY.md §5.3-5.4: a failed split is retried from the last averaged
+params — here a failed/preempted process restarts from the newest checkpoint
+zip, TPU-preemption style).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, List, Optional
+
+from ..optimize.listeners import TrainingListener
+from .serialization import restore_model, write_model
+
+_CKPT_RE = re.compile(r"^checkpoint_epoch(\d+)\.zip$")
+
+
+class CheckpointListener(TrainingListener):
+    """Writes ``checkpoint_epoch{N}.zip`` at epoch boundaries (atomic rename
+    so a preemption mid-write never leaves a truncated newest checkpoint),
+    keeping the last ``keep_last``."""
+
+    def __init__(self, directory: str, every_n_epochs: int = 1,
+                 keep_last: int = 3, save_updater: bool = True):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.every_n_epochs = max(1, every_n_epochs)
+        self.keep_last = keep_last
+        self.save_updater = save_updater
+        self._epoch = 0
+
+    def iteration_done(self, model, iteration, score):
+        pass
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        self._epoch += 1
+        if self._epoch % self.every_n_epochs:
+            return
+        final = os.path.join(self.directory,
+                             f"checkpoint_epoch{self._epoch}.zip")
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        os.close(fd)
+        try:
+            write_model(model, tmp, save_updater=self.save_updater)
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._prune()
+
+    def _prune(self):
+        ckpts = list_checkpoints(self.directory)
+        for path, _ in ckpts[:-self.keep_last]:
+            os.unlink(path)
+
+
+def list_checkpoints(directory: str) -> List[tuple]:
+    """[(path, epoch)] sorted by epoch ascending."""
+    out = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append((os.path.join(directory, name), int(m.group(1))))
+    return sorted(out, key=lambda t: t[1])
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    ckpts = list_checkpoints(directory)
+    return ckpts[-1][0] if ckpts else None
+
+
+def fit_with_checkpointing(net, iterator, *, epochs: int, checkpoint_dir: str,
+                           every_n_epochs: int = 1, keep_last: int = 3,
+                           load_updater: bool = True):
+    """Resumable training loop: restores the newest checkpoint in
+    ``checkpoint_dir`` (params + updater state), then trains only the
+    REMAINING epochs, checkpointing as it goes. Safe to re-run after a crash
+    or preemption — the loop continues where the newest checkpoint left off.
+    Returns (net, epochs_actually_run).
+    """
+    done = 0
+    latest = latest_checkpoint(checkpoint_dir)
+    if latest is not None:
+        restored = restore_model(latest, load_updater=load_updater)
+        if net.params is None:
+            net.init()
+        net.set_params_flat(restored.params_flat())
+        if load_updater and restored.opt_state is not None:
+            net.opt_state = restored.opt_state
+        done = list_checkpoints(checkpoint_dir)[-1][1]
+    remaining = max(0, epochs - done)
+    if remaining == 0:
+        return net, 0
+    listener = CheckpointListener(checkpoint_dir, every_n_epochs, keep_last)
+    listener._epoch = done
+    saved = list(net.listeners)
+    net.set_listeners(*(saved + [listener]))
+    try:
+        net.fit(iterator=iterator, epochs=remaining)
+    finally:
+        net.set_listeners(*saved)
+    return net, remaining
+
+
+class ProfilerListener(TrainingListener):
+    """XProf/TensorBoard trace capture for a window of iterations (SURVEY.md
+    §5.1: the reference has PerformanceListener throughput only; the TPU
+    build hooks jax.profiler so kernel-level traces land in ``log_dir``,
+    viewable with xprof/tensorboard)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 n_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.end_iteration = start_iteration + n_iterations
+        self._active = False
+        self._done = False
+
+    def iteration_done(self, model, iteration, score):
+        import jax
+        if self._done:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.end_iteration:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def on_epoch_end(self, model):
+        # never leak an open trace across a short run
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

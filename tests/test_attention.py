@@ -1,0 +1,102 @@
+"""Ring attention (sequence parallelism) + SelfAttentionLayer — net-new
+long-context capability (SURVEY.md §5.7: shardable sequence axis)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.ring_attention import (attention,
+                                                        ring_attention_sharded,
+                                                        sequence_sharding)
+from deeplearning4j_tpu.util.gradcheck import check_gradients
+
+R = np.random.default_rng(41)
+
+
+def _qkv(B=2, H=2, T=16, D=8):
+    return (jnp.asarray(R.normal(size=(B, H, T, D)).astype(np.float32)),
+            jnp.asarray(R.normal(size=(B, H, T, D)).astype(np.float32)),
+            jnp.asarray(R.normal(size=(B, H, T, D)).astype(np.float32)))
+
+
+def test_reference_attention_is_softmax():
+    q, k, v = _qkv(T=6)
+    out = attention(q, k, v)
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full_attention(causal):
+    """The 8-device ring with online softmax must equal single-device full
+    attention on the gathered sequence."""
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv(B=2, H=2, T=32, D=8)
+    want = np.asarray(attention(q, k, v, causal=causal))
+    fn = ring_attention_sharded(mesh, "seq", causal=causal)
+    sh = sequence_sharding(mesh, "seq")
+    qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+    got = np.asarray(jax.device_get(fn(qs, ks, vs)))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_ring_attention_memory_layout_stays_sharded():
+    mesh = make_mesh((8,), ("seq",))
+    fn = ring_attention_sharded(mesh, "seq")
+    sh = sequence_sharding(mesh, "seq")
+    q, k, v = _qkv(T=64)
+    out = fn(*(jax.device_put(t, sh) for t in (q, k, v)))
+    assert out.sharding.spec == P(None, None, "seq", None)
+
+
+def test_self_attention_layer_gradients():
+    conf = (NeuralNetConfiguration(seed=3, updater=Sgd(0.1), dtype="float64")
+            .list(SelfAttentionLayer(n_in=4, n_out=8, n_heads=2,
+                                     activation="identity"),
+                  RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 5)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(3, 5, 4))
+    y = np.eye(2)[(x.sum(-1) > 0).astype(int)]
+    assert check_gradients(net, x, y, subset=120, print_results=True)
+
+
+def test_self_attention_layer_masking_and_causal():
+    layer = SelfAttentionLayer(n_in=4, n_out=8, n_heads=2, causal=True,
+                               activation="identity", weight_init="xavier")
+    import jax
+    params, _ = layer.init(jax.random.PRNGKey(0), None, jnp.float32)
+    x = jnp.asarray(R.normal(size=(2, 6, 4)).astype(np.float32))
+    out_full, _ = layer.apply(params, {}, x)
+    # causal: output at step t must not change when the future changes
+    x2 = x.at[:, 4:].set(0.0)
+    out_trunc, _ = layer.apply(params, {}, x2)
+    np.testing.assert_allclose(np.asarray(out_full[:, :4]),
+                               np.asarray(out_trunc[:, :4]), atol=1e-5)
+    # masking: padded keys don't affect earlier outputs
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.float32)
+    out_masked, _ = layer.apply(params, {}, x, mask=mask)
+    assert np.isfinite(np.asarray(out_masked)).all()
+
+
+def test_attention_classifier_trains():
+    conf = (NeuralNetConfiguration(seed=9, updater=Adam(5e-3), dtype="float32")
+            .list(SelfAttentionLayer(n_out=16, n_heads=4, activation="identity"),
+                  RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(6, 10)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(32, 10, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(np.cumsum(x.sum(-1), 1) > 0).astype(int)]
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=20, batch_size=32)
+    assert net.score(x, y) < s0

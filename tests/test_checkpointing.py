@@ -1,0 +1,84 @@
+"""Checkpoint-restart orchestration + profiler hookup (SURVEY.md §5.1/§5.3:
+periodic checkpoints, resume-after-preemption, XProf trace capture)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.util.checkpointing import (CheckpointListener,
+                                                   ProfilerListener,
+                                                   fit_with_checkpointing,
+                                                   latest_checkpoint,
+                                                   list_checkpoints)
+
+R = np.random.default_rng(29)
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration(seed=seed, updater=Adam(5e-3), dtype="float32")
+            .list(DenseLayer(n_in=5, n_out=12, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _it(n=128, bs=32):
+    x = R.normal(size=(n, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+    return ListDataSetIterator(features=x, labels=y, batch_size=bs), x, y
+
+
+def test_checkpoint_listener_writes_and_prunes(tmp_path):
+    net = _net()
+    it, _, _ = _it()
+    net.set_listeners(CheckpointListener(str(tmp_path), every_n_epochs=1,
+                                         keep_last=2))
+    net.fit(iterator=it, epochs=5)
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [e for _, e in ckpts] == [4, 5]     # pruned to last 2
+    assert latest_checkpoint(str(tmp_path)).endswith("checkpoint_epoch5.zip")
+
+
+def test_fit_with_checkpointing_resumes(tmp_path):
+    d = str(tmp_path / "ck")
+    it, x, y = _it()
+
+    # run 1: 3 of 6 epochs, then "preemption"
+    a = _net()
+    fit_with_checkpointing(a, it, epochs=3, checkpoint_dir=d)
+    assert latest_checkpoint(d).endswith("epoch3.zip")
+    it.reset()
+
+    # run 2 in a FRESH process-equivalent: resumes at epoch 3, runs 3 more
+    b = _net()
+    b2, ran = fit_with_checkpointing(b, it, epochs=6, checkpoint_dir=d)
+    assert ran == 3
+    assert latest_checkpoint(d).endswith("epoch6.zip")
+
+    # a fully-complete run is a no-op
+    c = _net()
+    _, ran2 = fit_with_checkpointing(c, it, epochs=6, checkpoint_dir=d)
+    assert ran2 == 0
+    # restored params match the checkpointed ones
+    from deeplearning4j_tpu.util.serialization import restore_model
+    saved = restore_model(latest_checkpoint(d))
+    np.testing.assert_allclose(np.asarray(c.params_flat()),
+                               np.asarray(saved.params_flat()), atol=1e-6)
+
+
+def test_profiler_listener_writes_trace(tmp_path):
+    net = _net()
+    it, _, _ = _it(64, 16)
+    log_dir = str(tmp_path / "xprof")
+    net.set_listeners(ProfilerListener(log_dir, start_iteration=1,
+                                       n_iterations=2))
+    net.fit(iterator=it, epochs=2)
+    # a plugins/profile/<ts>/ dir with trace artifacts appears
+    found = []
+    for root, _, files in os.walk(log_dir):
+        found.extend(files)
+    assert found, "no profiler trace files written"

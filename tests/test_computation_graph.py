@@ -130,3 +130,29 @@ def test_simple_cnn_and_vgg_build():
     assert np.asarray(net.output(x)).shape == (2, 5)
     v = vgg16(n_classes=10, height=32, width=32, channels=3).init()
     assert np.asarray(v.output(x.repeat(2, axis=1).repeat(2, axis=2))).shape == (2, 10)
+
+
+def test_multi_output_evaluate_returns_per_output_evaluations():
+    """Reference evaluate is single-output; the TPU build returns one
+    Evaluation per network output for multi-output graphs."""
+    g = (NeuralNetConfiguration(seed=5, updater=Adam(5e-3), dtype="float32")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("o1", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "d")
+         .add_layer("o2", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "d")
+         .set_outputs("o1", "o2")
+         .set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(g.build()).init()
+    x = R.normal(size=(20, 4)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[R.integers(0, 2, 20)]
+    y2 = np.eye(3, dtype=np.float32)[R.integers(0, 3, 20)]
+    evs = net.evaluate(x, [y1, y2])
+    assert len(evs) == 2
+    assert 0.0 <= evs[0].accuracy() <= 1.0
+    assert 0.0 <= evs[1].accuracy() <= 1.0
+    # single-output graphs still return one Evaluation
+    single = _simple_graph()
+    xs = R.normal(size=(8, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[R.integers(0, 3, 8)]
+    assert hasattr(single.evaluate(xs, ys), "accuracy")

@@ -60,8 +60,15 @@ print(f"WORKER_{pid}_OK", flush=True)
 """)
 
 
-@pytest.mark.parametrize("port", [9391])
-def test_two_process_cpu_distributed(tmp_path, port):
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cpu_distributed(tmp_path):
+    port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)   # exactly 1 local CPU device per process

@@ -1,0 +1,107 @@
+"""EvaluationBinary + EvaluationCalibration + EvaluationTools HTML export
+(reference eval/EvaluationBinary.java, eval/EvaluationCalibration.java,
+evaluation/EvaluationTools.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (EvaluationBinary, EvaluationCalibration,
+                                     ROC, ROCBinary, calibration_chart_html,
+                                     export_roc_charts, roc_chart_html)
+
+R = np.random.default_rng(17)
+
+
+def test_evaluation_binary_counts_and_metrics():
+    e = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+    preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.6], [0.1, 0.7]])
+    e.eval(labels, preds)
+    # label 0: tp=2 tn=2 -> perfect
+    assert e.accuracy(0) == 1.0 and e.f1(0) == 1.0
+    assert e.matthews_correlation(0) == 1.0
+    # label 1: preds>0.5 -> [0,0,1,1]; labels [0,1,0,1] -> tp=1 fp=1 tn=1 fn=1
+    assert e.accuracy(1) == 0.5
+    assert e.precision(1) == 0.5 and e.recall(1) == 0.5
+    assert e.total_count(1) == 4
+    assert "label_0" in e.stats()
+
+
+def test_evaluation_binary_custom_threshold_and_mask():
+    e = EvaluationBinary(decision_threshold=np.array([0.9, 0.1]))
+    labels = np.array([[1, 1], [0, 0]])
+    preds = np.array([[0.95, 0.2], [0.5, 0.05]])
+    mask = np.array([[1, 1], [1, 0]])   # last entry of label 1 excluded
+    e.eval(labels, preds, mask=mask)
+    assert e.total_count(0) == 2
+    assert e.total_count(1) == 1
+    assert e.accuracy(0) == 1.0 and e.accuracy(1) == 1.0
+
+
+def test_evaluation_binary_merge_and_timeseries():
+    a, b = EvaluationBinary(), EvaluationBinary()
+    l1 = (R.random((6, 3)) > 0.5).astype(float)
+    p1 = R.random((6, 3))
+    l2 = (R.random((4, 3)) > 0.5).astype(float)
+    p2 = R.random((4, 3))
+    a.eval(l1, p1)
+    b.eval(l2, p2)
+    a.merge(b)
+    both = EvaluationBinary()
+    both.eval(np.concatenate([l1, l2]), np.concatenate([p1, p2]))
+    np.testing.assert_array_equal(a.tp, both.tp)
+    np.testing.assert_array_equal(a.fn, both.fn)
+    # [B,T,L] time series path
+    ts = EvaluationBinary()
+    ts.eval(l1.reshape(2, 3, 3), p1.reshape(2, 3, 3))
+    flat = EvaluationBinary()
+    flat.eval(l1, p1)
+    np.testing.assert_array_equal(ts.tp, flat.tp)
+
+
+def test_calibration_perfectly_calibrated():
+    """Predictions drawn so P(label=1|p) == p: ECE should be near 0."""
+    n = 20000
+    p = R.random(n)
+    y = (R.random(n) < p).astype(float)
+    cal = EvaluationCalibration(reliability_bins=10)
+    cal.eval(np.stack([1 - y, y], 1), np.stack([1 - p, p], 1))
+    ece = cal.expected_calibration_error(1)
+    assert ece < 0.02, ece
+    mean_pred, frac_pos, counts = cal.reliability_diagram(1)
+    assert counts.sum() == n
+    np.testing.assert_allclose(mean_pred[counts > 100], frac_pos[counts > 100],
+                               atol=0.05)
+
+
+def test_calibration_overconfident_model_detected():
+    n = 5000
+    y = (R.random(n) < 0.5).astype(float)
+    p = np.where(y > 0, 0.99, 0.01)           # overconfident but...
+    wrong = R.random(n) < 0.3                 # ...wrong 30% of the time
+    p = np.where(wrong, 1 - p, p)
+    cal = EvaluationCalibration()
+    cal.eval(np.stack([1 - y, y], 1), np.stack([1 - p, p], 1))
+    assert cal.expected_calibration_error(1) > 0.2
+    edges, counts = cal.residual_plot()
+    assert counts.sum() == 2 * n
+
+
+def test_html_exports(tmp_path):
+    roc = ROC()
+    y = (R.random(500) > 0.5).astype(float)
+    s = np.clip(y * 0.6 + R.random(500) * 0.4, 0, 1)
+    roc.eval(np.stack([1 - y, y], 1), np.stack([1 - s, s], 1))
+    html = roc_chart_html(roc)
+    assert "<svg" in html and "AUC=" in html
+    path = str(tmp_path / "roc.html")
+    export_roc_charts(path, roc)
+    assert "<svg" in open(path).read()
+
+    rb = ROCBinary()
+    rb.eval((R.random((100, 3)) > 0.5).astype(float), R.random((100, 3)))
+    assert "class 2" in roc_chart_html(rb, "per-label ROC")
+
+    cal = EvaluationCalibration()
+    cal.eval(np.stack([1 - y, y], 1), np.stack([1 - s, s], 1))
+    chtml = calibration_chart_html(cal)
+    assert "Reliability" in chtml and "Residual" in chtml

@@ -133,3 +133,67 @@ def test_sgns_scatter_update_matches_dense_autodiff():
     got0, got1, _ = step(syn0, syn1, centers, contexts, negs, lr)
     np.testing.assert_allclose(np.asarray(got0), np.asarray(want0), atol=1e-6)
     np.testing.assert_allclose(np.asarray(got1), np.asarray(want1), atol=1e-6)
+
+
+def test_paragraph_vectors_pv_dm():
+    """PV-DM mode (reference learning/impl/sequence/DM.java): doc vectors of
+    same-topic docs end up closer than cross-topic, and infer_vector works."""
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+
+    cats = ["the cat sat on the mat and purred softly today",
+            "a cat chased the small mouse around the mat",
+            "my cat naps on a warm mat every afternoon"]
+    cars = ["the car drove down the long road very fast",
+            "a fast car raced along the road at night",
+            "my car needs fuel before the long road trip"]
+    docs = [(f"cat_{i}", t) for i, t in enumerate(cats)] + \
+           [(f"car_{i}", t) for i, t in enumerate(cars)]
+    pv = ParagraphVectors(layer_size=24, window=3, epochs=40, negative=4,
+                          seed=11, dm=True, learning_rate=0.05)
+    pv.fit(docs)
+    assert pv.doc_vectors.shape == (6, 24)
+
+    def sim(a, b):
+        va, vb = pv.get_doc_vector(a), pv.get_doc_vector(b)
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)))
+
+    same = np.mean([sim("cat_0", "cat_1"), sim("cat_0", "cat_2"),
+                    sim("car_0", "car_1"), sim("car_0", "car_2")])
+    cross = np.mean([sim("cat_0", "car_0"), sim("cat_1", "car_1"),
+                     sim("cat_2", "car_2")])
+    assert same > cross
+    v = pv.infer_vector("the cat sat on a mat")
+    assert v.shape == (24,) and np.isfinite(v).all()
+
+
+def test_bag_of_words_and_tfidf_vectorizers():
+    from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
+                                                    CollectionDocumentIterator,
+                                                    FileDocumentIterator,
+                                                    TfidfVectorizer)
+    docs = ["apple banana apple", "banana cherry", "apple cherry cherry date"]
+    bow = BagOfWordsVectorizer().fit(docs)
+    assert bow.vocab == ["apple", "banana", "cherry", "date"]
+    np.testing.assert_array_equal(bow.transform("apple apple banana"),
+                                  [2, 1, 0, 0])
+    m = bow.transform_documents(docs)
+    assert m.shape == (3, 4)
+
+    tfidf = TfidfVectorizer().fit(docs)
+    v = tfidf.transform("apple date")
+    # 'date' appears in 1 doc, 'apple' in 2 -> idf(date) > idf(apple)
+    assert v[3] > v[0] > 0
+    assert tfidf.tfidf_word("banana", "apple date") == 0.0
+
+    ds = bow.vectorize("apple banana", "fruit", ["fruit", "other"])
+    assert ds.features.shape == (1, 4) and ds.labels[0, 0] == 1.0
+
+    it = CollectionDocumentIterator(docs)
+    assert len(list(it)) == 3
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        for i, d in enumerate(docs):
+            with open(os.path.join(td, f"d{i}.txt"), "w") as f:
+                f.write(d)
+        fit2 = BagOfWordsVectorizer().fit(FileDocumentIterator(td))
+        assert fit2.vocab == bow.vocab

@@ -1,0 +1,96 @@
+"""NearestNeighborsServer/-Client + EarlyStoppingParallelTrainer (reference
+NearestNeighborsServer.java + parallelism/EarlyStoppingParallelTrainer.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.clustering.server import (NearestNeighborsClient,
+                                                  NearestNeighborsServer)
+from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping.early_stopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.earlystopping.parallel_trainer import (
+    EarlyStoppingParallelTrainer)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+R = np.random.default_rng(23)
+
+
+def test_nn_server_roundtrip():
+    pts = R.normal(size=(60, 8))
+    srv = NearestNeighborsServer(pts)
+    port = srv.start()
+    try:
+        cl = NearestNeighborsClient(port=port)
+        out = cl.knn(index=5, k=3)
+        brute = np.argsort(np.linalg.norm(pts - pts[5], axis=1))[1:4]
+        assert set(out["indices"]) == set(int(i) for i in brute)
+        assert 5 not in out["indices"]
+
+        q = R.normal(size=8)
+        out2 = cl.knn_new(q, k=4)
+        brute2 = np.argsort(np.linalg.norm(pts - q, axis=1))[:4]
+        assert set(out2["indices"]) == set(int(i) for i in brute2)
+        assert out2["distances"] == sorted(out2["distances"])
+
+        # error surface: bad index -> 400 with message
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            cl.knn(index=1000, k=2)
+    finally:
+        srv.stop()
+
+
+def test_early_stopping_parallel_trainer():
+    x = R.normal(size=(256, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration(seed=2, updater=Adam(5e-3), dtype="float32")
+            .list(DenseLayer(n_in=6, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    train_it = ListDataSetIterator(features=x, labels=y, batch_size=64)
+    val_it = ListDataSetIterator(features=x, labels=y, batch_size=128)
+    es_conf = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val_it),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(12),
+            ScoreImprovementEpochTerminationCondition(5)])
+    trainer = EarlyStoppingParallelTrainer(es_conf, net, train_it, workers=None)
+    result = trainer.fit()
+    assert result.total_epochs <= 13
+    assert result.best_model is not None
+    assert result.best_model_score < list(result.score_vs_epoch.values())[0]
+    # fit was restored to the normal path
+    net.fit(x, y, epochs=1, batch_size=64)
+
+
+def test_timeseries_utils_and_viterbi():
+    from deeplearning4j_tpu.util.timeseries import (
+        Viterbi, moving_average, reshape_2d_to_3d, reshape_3d_to_2d,
+        reshape_time_series_mask_to_vector, reshape_vector_to_time_series_mask)
+
+    x = np.arange(6, dtype=float)
+    np.testing.assert_allclose(moving_average(x, 3), [1, 2, 3, 4])
+
+    a = R.normal(size=(4, 5, 3))
+    np.testing.assert_array_equal(reshape_2d_to_3d(reshape_3d_to_2d(a), 4), a)
+    m = (R.random((4, 5)) > 0.5).astype(float)
+    np.testing.assert_array_equal(
+        reshape_vector_to_time_series_mask(
+            reshape_time_series_mask_to_vector(m), 4), m)
+
+    # Viterbi smooths an isolated observation flip
+    v = Viterbi([0, 1], meta_stability=0.9)
+    ll, path = v.decode(np.array([0, 0, 1, 0, 0]))
+    np.testing.assert_array_equal(path, [0, 0, 0, 0, 0])
+    assert ll < 0
+    # a sustained switch is kept
+    _, path2 = v.decode(np.array([0, 0, 1, 1, 1, 1]))
+    np.testing.assert_array_equal(path2[-3:], [1, 1, 1])
+    # probability-row input
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.1, 0.9]])
+    _, path3 = v.decode(probs)
+    np.testing.assert_array_equal(path3, [0, 1, 1])

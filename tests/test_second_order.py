@@ -1,0 +1,83 @@
+"""Second-order solvers: line search, CG, LBFGS (reference
+optimize/solvers/{LineGradientDescent,ConjugateGradient,LBFGS,
+BackTrackLineSearch}.java; OptimizationAlgorithm dispatch)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.second_order import (BackTrackLineSearch,
+                                                      LBFGS, make_optimizer)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+R = np.random.default_rng(8)
+
+
+def test_backtrack_line_search_quadratic():
+    f = lambda x: float(np.sum((x - 1.0) ** 2))
+    x0 = np.zeros(3)
+    g0 = 2 * (x0 - 1.0)
+    ls = BackTrackLineSearch(max_iterations=20)
+    step, fx = ls.search(f, x0, -g0, f(x0), g0, initial_step=1.0)
+    assert step > 0
+    assert fx < f(x0)
+    # ascent direction is rejected
+    step2, fx2 = ls.search(f, x0, g0, f(x0), g0)
+    assert step2 == 0.0 and fx2 == f(x0)
+
+
+def _net(algo, seed=4):
+    conf = (NeuralNetConfiguration(seed=seed, updater=Sgd(0.1), dtype="float64",
+                                   optimization_algorithm=algo,
+                                   max_num_line_search_iterations=8)
+            .list(DenseLayer(n_in=4, n_out=12, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=120):
+    x = R.normal(size=(n, 4))
+    yi = (x[:, 0] + x[:, 1] > 0).astype(int) + (x[:, 2] > 0.5).astype(int)
+    return x, np.eye(3)[yi]
+
+
+@pytest.mark.parametrize("algo", ["line_gradient_descent",
+                                  "conjugate_gradient", "lbfgs"])
+def test_second_order_solvers_reduce_score(algo):
+    net = _net(algo)
+    x, y = _data()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=25, batch_size=120)   # full-batch outer iterations
+    s1 = net.score(x, y)
+    assert s1 < s0 * 0.8, (s0, s1)
+    assert net.evaluate(x, y).accuracy() > 0.7
+
+
+def test_lbfgs_beats_plain_gd_on_quadratic_net():
+    """On a smooth full-batch objective LBFGS should make at least as much
+    progress per outer iteration as steepest descent."""
+    x, y = _data(80)
+    a = _net("line_gradient_descent", seed=6)
+    b = _net("lbfgs", seed=6)
+    b.set_params_flat(a.params_flat())
+    a.fit(x, y, epochs=15, batch_size=80)
+    b.fit(x, y, epochs=15, batch_size=80)
+    assert b.score(x, y) <= a.score(x, y) * 1.05
+
+
+def test_unknown_algorithm_raises():
+    net = _net("sgd")
+    with pytest.raises(ValueError, match="available"):
+        make_optimizer("newton", net)
+
+
+def test_lbfgs_history_curvature_guard():
+    net = _net("lbfgs")
+    opt = LBFGS(net)
+    x, y = _data(40)
+    for _ in range(6):
+        opt.step(x, y)
+    assert len(opt._hist) >= 1
+    for s, yv in opt._hist:
+        assert float(s @ yv) > 0

@@ -1,0 +1,148 @@
+"""KD/Quad/Sp trees, Barnes-Hut t-SNE, graph API + DeepWalk (reference
+clustering/kdtree/KDTree.java, quadtree/QuadTree.java, sptree/SpTree.java,
+plot/BarnesHutTsne.java, deeplearning4j-graph DeepWalk.java:31)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.trees import KDTree, QuadTree, SpTree
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+from deeplearning4j_tpu.graphs import (DeepWalk, Graph, RandomWalkIterator,
+                                       WeightedRandomWalkIterator)
+
+R = np.random.default_rng(5)
+
+
+# -------------------------------------------------------------------- KDTree
+def test_kdtree_knn_matches_bruteforce():
+    pts = R.normal(size=(200, 5))
+    tree = KDTree(pts)
+    assert len(tree) == 200
+    for _ in range(10):
+        q = R.normal(size=5)
+        idxs, dists = tree.knn(q, 7)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+        np.testing.assert_array_equal(np.sort(idxs), np.sort(brute))
+        assert dists == sorted(dists)
+
+
+def test_kdtree_insert_and_nn():
+    tree = KDTree(dims=2)
+    tree.insert([0.0, 0.0])
+    tree.insert([1.0, 1.0])
+    tree.insert([-1.0, 0.5])
+    i, d = tree.nn([0.9, 0.9])
+    assert i == 1
+    assert abs(d - np.sqrt(0.02)) < 1e-9
+
+
+# ------------------------------------------------------------------- SpTree
+def test_sptree_mass_and_bh_forces_match_exact_for_small_theta():
+    pts = R.normal(size=(100, 2))
+    tree = SpTree.build(pts)
+    assert tree.count == 100
+    np.testing.assert_allclose(tree.cum_center / tree.count, pts.mean(0),
+                               atol=1e-9)
+    # theta=0: Barnes-Hut degenerates to the exact per-point sum
+    for i in [0, 17, 55]:
+        neg = np.zeros(2)
+        z = tree.compute_non_edge_forces(pts[i], 0.0, neg)
+        d2 = np.sum((pts[i] - pts) ** 2, 1)
+        q = 1.0 / (1.0 + d2)
+        mask = np.arange(100) != i
+        z_exact = q[mask].sum()
+        neg_exact = ((q[mask] ** 2)[:, None] * (pts[i] - pts[mask])).sum(0)
+        np.testing.assert_allclose(z, z_exact, rtol=1e-9)
+        np.testing.assert_allclose(neg, neg_exact, rtol=1e-7, atol=1e-10)
+
+
+def test_sptree_theta_approximation_close():
+    pts = R.normal(size=(300, 2))
+    tree = SpTree.build(pts)
+    neg_a, neg_e = np.zeros(2), np.zeros(2)
+    z_a = tree.compute_non_edge_forces(pts[3], 0.5, neg_a)
+    z_e = tree.compute_non_edge_forces(pts[3], 0.0, neg_e)
+    assert abs(z_a - z_e) / z_e < 0.1
+
+
+def test_quadtree_2d_only():
+    pts = R.normal(size=(50, 2))
+    t = QuadTree.build(pts)
+    assert t.count == 50
+    with pytest.raises(ValueError):
+        QuadTree(np.zeros(3), np.ones(3))
+
+
+# ----------------------------------------------------------- Barnes-Hut tSNE
+def test_barnes_hut_tsne_separates_clusters():
+    a = R.normal(size=(40, 10)) + 8.0
+    b = R.normal(size=(40, 10)) - 8.0
+    X = np.vstack([a, b])
+    Y = BarnesHutTsne(perplexity=10, n_iter=150, seed=1,
+                      theta=0.5).fit_transform(X)
+    assert Y.shape == (80, 2)
+    da = Y[:40].mean(0)
+    db = Y[40:].mean(0)
+    between = np.linalg.norm(da - db)
+    within = max(np.linalg.norm(Y[:40] - da, axis=1).mean(),
+                 np.linalg.norm(Y[40:] - db, axis=1).mean())
+    assert between > 2 * within
+
+
+# ------------------------------------------------------------ graph/DeepWalk
+def _two_cliques(k=6):
+    g = Graph(2 * k)
+    for i in range(k):
+        for j in range(i + 1, k):
+            g.add_edge(i, j)
+            g.add_edge(k + i, k + j)
+    g.add_edge(0, k)   # single bridge
+    return g
+
+
+def test_random_walks_stay_mostly_in_clique():
+    g = _two_cliques()
+    walks = list(RandomWalkIterator(g, walk_length=10, seed=3))
+    assert len(walks) == g.num_vertices()
+    assert all(len(w) == 11 for w in walks)
+    # disconnected vertex self-loops
+    g2 = Graph(3)
+    g2.add_edge(0, 1)
+    walks2 = {w[0]: w for w in RandomWalkIterator(g2, walk_length=4, seed=1)}
+    assert walks2[2] == [2, 2, 2, 2, 2]
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3, directed=True)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.001)
+    seen1 = sum(1 for w in
+                [next(iter(WeightedRandomWalkIterator(g, 1, seed=s)))
+                 for s in range(30)]
+                if w[0] == 0 and len(w) > 1 and w[1] == 1)
+    starts0 = sum(1 for s in range(30)
+                  for w in [next(iter(WeightedRandomWalkIterator(g, 1, seed=s)))]
+                  if w[0] == 0)
+    if starts0:
+        assert seen1 / starts0 > 0.9
+
+
+def test_deepwalk_embeds_cliques_closer():
+    g = _two_cliques()
+    dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                  walks_per_vertex=8, epochs=3, seed=7).fit(g)
+    table = dw.lookup_table
+    assert table.shape == (12, 16)
+    same = np.mean([dw.similarity(i, j) for i in range(1, 6)
+                    for j in range(1, 6) if i < j])
+    cross = np.mean([dw.similarity(i, j) for i in range(1, 6)
+                     for j in range(7, 12)])
+    assert same > cross
+    assert dw.verts_nearest(1, 3)
+
+
+def test_deepwalk_from_explicit_walks():
+    walks = [[0, 1, 2, 1, 0] for _ in range(20)] + \
+            [[3, 4, 5, 4, 3] for _ in range(20)]
+    dw = DeepWalk(vector_size=8, window_size=2, epochs=2, seed=2).fit(walks)
+    assert dw.lookup_table.shape == (6, 8)
+    assert dw.similarity(0, 1) > dw.similarity(0, 4)

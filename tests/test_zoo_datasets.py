@@ -44,32 +44,39 @@ def _step_graph(net, h, w, n_classes, batch=2):
 
 
 def test_zoo_extra_models_build():
-    """Cheap structure checks: init + param counts (the full train-step
-    compiles are in the slow-marked tests below)."""
-    # reference GoogLeNet has ~7M params at 1000 classes
-    assert 5_000_000 < googlenet(n_classes=1000).init().num_params() < 9_000_000
-    assert facenet_nn4_small2(n_classes=5, height=64, width=64,
+    """Cheap structure checks: init + param counts at small spatial dims
+    (full-size counts and train-step compiles are in the slow tests)."""
+    # GoogLeNet's param count is input-size independent (global pooling);
+    # ~6M at 10 classes vs reference ~7M at 1000 (the fc1 input is 1024)
+    assert 4_000_000 < googlenet(n_classes=10, height=48,
+                                 width=48).init().num_params() < 9_000_000
+    assert facenet_nn4_small2(n_classes=5, height=48, width=48,
                               embedding_size=32).init().num_params() > 1_000_000
-    assert inception_resnet_v1(n_classes=5, height=64, width=64,
-                               embedding_size=32, res_a=1, res_b=1,
-                               res_c=1).init().num_params() > 1_000_000
 
 
 @pytest.mark.slow
 def test_googlenet_steps():
+    # reference GoogLeNet has ~7M params at 1000 classes
+    assert 5_000_000 < googlenet(n_classes=1000).init().num_params() < 9_000_000
     net = googlenet(n_classes=7, height=64, width=64).init()
     out = _step_graph(net, 64, 64, 7)
     assert np.allclose(out.sum(-1), 1.0, atol=1e-4)
 
 
-def test_facenet_nn4_small2_steps_and_l2_embeddings():
+def test_facenet_l2_embeddings_forward():
+    net = facenet_nn4_small2(n_classes=5, height=48, width=48,
+                             embedding_size=32).init()
+    # embeddings vertex is L2-normalized
+    acts = net.feed_forward(R.normal(size=(3, 48, 48, 3)).astype(np.float32))
+    emb = np.asarray(acts["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_facenet_nn4_small2_steps():
     net = facenet_nn4_small2(n_classes=5, height=64, width=64,
                              embedding_size=32).init()
     _step_graph(net, 64, 64, 5)
-    # embeddings vertex is L2-normalized
-    acts = net.feed_forward(R.normal(size=(3, 64, 64, 3)).astype(np.float32))
-    emb = np.asarray(acts["embeddings"])
-    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)
 
 
 @pytest.mark.slow
@@ -77,6 +84,7 @@ def test_inception_resnet_v1_steps():
     net = inception_resnet_v1(n_classes=5, height=64, width=64,
                               embedding_size=32,
                               res_a=1, res_b=1, res_c=1).init()
+    assert net.num_params() > 1_000_000
     _step_graph(net, 64, 64, 5)
 
 

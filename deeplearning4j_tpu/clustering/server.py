@@ -43,11 +43,12 @@ class NearestNeighborsServer:
         import http.server
         server = self
 
+        from ..util.httpjson import read_json, write_json
+
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_POST(self):   # noqa: N802 (stdlib API)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    req = read_json(self)
                     k = int(req.get("k", 1))
                     if self.path == "/knn":
                         i = int(req["index"])
@@ -65,21 +66,11 @@ class NearestNeighborsServer:
                     else:
                         self.send_error(404)
                         return
-                    body = json.dumps({"indices": [int(j) for j in idxs],
-                                       "distances": [float(d) for d in dists]}
-                                      ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    write_json(self, 200,
+                               {"indices": [int(j) for j in idxs],
+                                "distances": [float(d) for d in dists]})
                 except Exception as e:   # client error surface
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    write_json(self, 400, {"error": str(e)})
 
             def log_message(self, *a):   # quiet
                 pass

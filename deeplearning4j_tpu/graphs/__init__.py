@@ -1,5 +1,6 @@
 from .deepwalk import DeepWalk
 from .graph import Graph, RandomWalkIterator, WeightedRandomWalkIterator
+from .node2vec import Node2Vec, Node2VecWalkIterator
 
-__all__ = ["DeepWalk", "Graph", "RandomWalkIterator",
-           "WeightedRandomWalkIterator"]
+__all__ = ["DeepWalk", "Graph", "Node2Vec", "Node2VecWalkIterator",
+           "RandomWalkIterator", "WeightedRandomWalkIterator"]

@@ -1,0 +1,76 @@
+"""node2vec: biased second-order random-walk embeddings.
+
+Reference: models/node2vec/ — a stub in the reference snapshot (SURVEY.md
+§2.3 notes "Stub/partial"); completed here per the published algorithm
+(Grover & Leskovec 2016): return parameter ``p`` and in-out parameter ``q``
+bias the walk between BFS-like (community) and DFS-like (structural)
+exploration. Training rides DeepWalk's SequenceVectors path.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .deepwalk import DeepWalk
+from .graph import Graph
+
+
+class Node2VecWalkIterator:
+    """Second-order biased walks: transition weight from (prev -> cur -> nxt)
+    is 1/p when nxt == prev, 1 when nxt neighbors prev, 1/q otherwise."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 123):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.p = p
+        self.q = q
+        self.seed = seed
+        self._epoch = 0
+        self._nbr_sets = [set(graph._adj[v]) for v in range(graph.n)]
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self._epoch)
+        self._epoch += 1
+        for start in rng.permutation(self.graph.n):
+            walk = [int(start)]
+            for _ in range(self.walk_length):
+                cur = walk[-1]
+                nbrs = self.graph._adj[cur]
+                if not nbrs:
+                    walk.append(cur)
+                    continue
+                if len(walk) == 1:
+                    walk.append(int(nbrs[rng.integers(0, len(nbrs))]))
+                    continue
+                prev = walk[-2]
+                prev_nbrs = self._nbr_sets[prev]
+                w = np.asarray([1.0 / self.p if x == prev
+                                else (1.0 if x in prev_nbrs else 1.0 / self.q)
+                                for x in nbrs])
+                walk.append(int(rng.choice(nbrs, p=w / w.sum())))
+            yield walk
+
+    def reset(self):
+        pass
+
+
+class Node2Vec(DeepWalk):
+    """DeepWalk with node2vec's biased walk generator."""
+
+    def __init__(self, *, p: float = 1.0, q: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+        self.q = q
+
+    def fit(self, graph_or_walks):
+        if isinstance(graph_or_walks, Graph):
+            walks: List[List[int]] = []
+            self._n_vertices = graph_or_walks.num_vertices()
+            for rep in range(self.walks_per_vertex):
+                it = Node2VecWalkIterator(graph_or_walks, self.walk_length,
+                                          self.p, self.q, seed=self.seed + rep)
+                walks.extend(it)
+            return super().fit(walks)
+        return super().fit(graph_or_walks)

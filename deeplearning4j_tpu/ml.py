@@ -1,0 +1,87 @@
+"""Estimator-style pipeline wrappers (fit/predict/score).
+
+Reference: deeplearning4j-scaleout spark/dl4j-spark-ml —
+SparkDl4jNetwork.scala wraps the network as an org.apache.spark.ml
+Estimator/Model so it slots into ML pipelines. The Python-ecosystem analogue
+is the scikit-learn estimator contract: ``fit(X, y)`` / ``predict`` /
+``predict_proba`` / ``score``, integer or one-hot labels accepted.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+class NeuralNetClassifier:
+    """sklearn-style classifier around a MultiLayerConfiguration (or an
+    already-built network)."""
+
+    def __init__(self, conf_or_net, *, epochs: int = 10, batch_size: int = 32):
+        self.epochs = epochs
+        self.batch_size = batch_size
+        if hasattr(conf_or_net, "fit"):
+            self.net = conf_or_net
+        else:
+            from .nn.multilayer import MultiLayerNetwork
+            self.net = MultiLayerNetwork(conf_or_net)
+        self.n_classes_: Optional[int] = None
+
+    def _one_hot(self, y):
+        y = np.asarray(y)
+        if y.ndim == 2:          # already one-hot
+            self.n_classes_ = y.shape[1]
+            return y.astype(np.float32)
+        classes = int(y.max()) + 1 if self.n_classes_ is None else self.n_classes_
+        self.n_classes_ = classes
+        return np.eye(classes, dtype=np.float32)[y.astype(int)]
+
+    def fit(self, X, y, **fit_kwargs):
+        Y = self._one_hot(y)
+        self.net.fit(np.asarray(X, np.float32), Y, epochs=self.epochs,
+                     batch_size=self.batch_size, **fit_kwargs)
+        return self
+
+    def predict_proba(self, X) -> np.ndarray:
+        return np.asarray(self.net.output(np.asarray(X, np.float32)))
+
+    def predict(self, X) -> np.ndarray:
+        return self.predict_proba(X).argmax(-1)
+
+    def score(self, X, y) -> float:
+        """Mean accuracy (sklearn contract)."""
+        y = np.asarray(y)
+        if y.ndim == 2:
+            y = y.argmax(-1)
+        return float((self.predict(X) == y).mean())
+
+    def get_params(self, deep: bool = True):
+        return {"epochs": self.epochs, "batch_size": self.batch_size}
+
+    def set_params(self, **params):
+        for k, v in params.items():
+            setattr(self, k, v)
+        return self
+
+
+class NeuralNetRegressor(NeuralNetClassifier):
+    """sklearn-style regressor: targets pass through; score is R^2."""
+
+    def fit(self, X, y, **fit_kwargs):
+        y = np.asarray(y, np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        self.net.fit(np.asarray(X, np.float32), y, epochs=self.epochs,
+                     batch_size=self.batch_size, **fit_kwargs)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        out = np.asarray(self.net.output(np.asarray(X, np.float32)))
+        return out[:, 0] if out.shape[-1] == 1 else out
+
+    def score(self, X, y) -> float:
+        y = np.asarray(y, np.float64).reshape(-1)
+        pred = np.asarray(self.predict(X), np.float64).reshape(-1)
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / max(ss_tot, 1e-12)

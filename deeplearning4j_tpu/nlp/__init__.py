@@ -1,3 +1,4 @@
+from .distributed_w2v import DistributedWord2Vec
 from .glove import Glove
 from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
                                 LabelAwareIterator, LabelledDocument,
@@ -5,9 +6,9 @@ from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
 from .sequence_vectors import SequenceVectors
 from .serde import (read_binary_word_vectors, read_word_vectors,
                     write_binary_word_vectors, write_word_vectors)
-from .tokenizer import (CommonPreprocessor, DefaultTokenizerFactory,
-                        LowCasePreProcessor, NGramTokenizerFactory,
-                        TokenizerFactory)
+from .tokenizer import (CJKTokenizerFactory, CommonPreprocessor,
+                        DefaultTokenizerFactory, LowCasePreProcessor,
+                        NGramTokenizerFactory, TokenizerFactory)
 from .vectorizers import (BagOfWordsVectorizer, CollectionDocumentIterator,
                           DocumentIterator, FileDocumentIterator,
                           TfidfVectorizer)

@@ -93,3 +93,45 @@ class NGramTokenizerFactory(TokenizerFactory):
             for i in range(len(base) - n + 1):
                 out.append(" ".join(base[i:i + n]))
         return Tokenizer(out)
+
+
+class CJKTokenizerFactory(TokenizerFactory):
+    """Language-pack seam for Chinese/Japanese/Korean text (reference
+    deeplearning4j-nlp-{chinese,japanese,korean} vendor ansj/kuromoji
+    segmenters). Without a vendored segmenter, the robust zero-dependency
+    behavior is: contiguous Latin/digit runs stay whole words; CJK ideographs
+    are emitted as overlapping character bigrams (standard CJK IR fallback;
+    unigrams when ``bigrams=False``); hangul syllable runs stay whole
+    (Korean is space-delimited). A real segmenter can be plugged via
+    ``segmenter=`` (callable: str -> List[str]), which is the reference's
+    pluggable-tokenizer capability."""
+
+    _runs = re.compile(
+        r"[A-Za-z0-9']+"                 # latin / digits
+        r"|[一-鿿぀-ヿ]+"  # CJK ideographs + kana
+        r"|[가-힯]+"             # hangul syllables
+    )
+    _cjk = re.compile(r"[一-鿿぀-ヿ]")
+
+    def __init__(self, pre_processor: Optional[TokenPreProcessor] = None,
+                 bigrams: bool = True, segmenter: Optional[Callable] = None):
+        super().__init__(pre_processor)
+        self.bigrams = bigrams
+        self.segmenter = segmenter
+
+    def create(self, text: str) -> Tokenizer:
+        if self.segmenter is not None:
+            toks = list(self.segmenter(text))
+        else:
+            toks = []
+            for run in self._runs.findall(text):
+                if self._cjk.match(run):
+                    if self.bigrams and len(run) > 1:
+                        toks.extend(run[i:i + 2] for i in range(len(run) - 1))
+                    else:
+                        toks.extend(run)
+                else:
+                    toks.append(run)
+        if self.pre_processor is not None:
+            toks = [self.pre_processor.pre_process(t) for t in toks]
+        return Tokenizer([t for t in toks if t])

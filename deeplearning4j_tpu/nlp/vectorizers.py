@@ -84,6 +84,7 @@ class BagOfWordsVectorizer:
         self.index = {w: i for i, w in enumerate(self.vocab)}
         self.doc_freq = np.asarray([dfs.get(w, 0) for w in self.vocab],
                                    np.float64)
+        self._idf = None     # invalidate any cached idf on refit
         return self
 
     def transform(self, text: str) -> np.ndarray:
@@ -110,7 +111,10 @@ class TfidfVectorizer(BagOfWordsVectorizer):
     idf = log(n_docs / doc_freq), smoothed here to avoid division by zero)."""
 
     def idf(self) -> np.ndarray:
-        return np.log((1.0 + self.n_docs) / (1.0 + self.doc_freq)) + 1.0
+        if getattr(self, "_idf", None) is None:
+            self._idf = np.log((1.0 + self.n_docs)
+                               / (1.0 + self.doc_freq)) + 1.0
+        return self._idf
 
     def transform(self, text: str) -> np.ndarray:
         counts = super().transform(text)
@@ -120,4 +124,7 @@ class TfidfVectorizer(BagOfWordsVectorizer):
         i = self.index.get(word)
         if i is None:
             return 0.0
-        return float(self.transform(text)[i])
+        for t in self._tokens(text):
+            if t == word:
+                return float(super().transform(text)[i] * self.idf()[i])
+        return 0.0

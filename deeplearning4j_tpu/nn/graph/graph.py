@@ -334,6 +334,11 @@ class ComputationGraph:
                     f"{n_out}-output graph ({self.conf.network_outputs}); "
                     f"pass one per output (None to skip an output)")
             masks_l = _as_list(lmask) if lmask is not None else [None] * n_out
+            if len(masks_l) != n_out:
+                raise ValueError(
+                    f"evaluate() got {len(masks_l)} label mask(s) for a "
+                    f"{n_out}-output graph; pass one per output (None for "
+                    f"unmasked outputs)")
             for e, o, l, m in zip(evals, outs, labels_l, masks_l):
                 if l is not None:
                     e.eval(l, np.asarray(o), mask=m)

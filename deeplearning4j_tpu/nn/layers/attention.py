@@ -74,20 +74,7 @@ class SelfAttentionLayer(LayerConf):
         q = self._heads(x @ params["Wq"])
         k = self._heads(x @ params["Wk"])
         v = self._heads(x @ params["Wv"])
-        if mask is not None:
-            # exclude padded timesteps as keys: zero their values and push
-            # their scores to -inf via a large negative bias on k
-            key_mask = jnp.asarray(mask, x.dtype)[:, None, None, :]  # [B,1,1,T]
-            scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], x.dtype))
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-            s = jnp.where(key_mask > 0, s, -1e30)
-            if self.causal:
-                T = s.shape[-1]
-                s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-        else:
-            out = attention(q, k, v, causal=self.causal)
+        out = attention(q, k, v, causal=self.causal, key_mask=mask)
         B, H, T, Dh = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
         if self.project_out:

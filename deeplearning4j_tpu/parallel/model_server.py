@@ -1,0 +1,87 @@
+"""Model-serving HTTP route.
+
+Reference: dl4j-streaming streaming/routes/DL4jServeRouteBuilder.java — the
+Camel/Kafka serving route that feeds incoming arrays to a model and publishes
+predictions. Stdlib HTTP replaces the Camel plumbing; batched inference rides
+ParallelInference (reference ParallelInference.BATCHED), so concurrent
+requests coalesce into one device batch.
+
+Endpoints (JSON):
+  POST /predict {"features": [[...], ...]}       -> {"output": [[...], ...]}
+  GET  /health                                   -> {"status": "ok", ...}
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .inference import ParallelInference
+
+
+class ModelServingServer:
+    def __init__(self, net, port: int = 0, host: str = "127.0.0.1",
+                 batched: bool = True, max_batch: int = 64):
+        self.net = net
+        self.host = host
+        self._port = port
+        self._pi = (ParallelInference(net, batch_limit=max_batch)
+                    if batched else None)
+        self._httpd = None
+        self._thread = None
+        self._count = 0
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> int:
+        import http.server
+        server = self
+
+        from ..util.httpjson import read_json, write_json
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):   # noqa: N802
+                if self.path == "/health":
+                    write_json(self, 200, {"status": "ok",
+                                           "model": type(server.net).__name__,
+                                           "requests_served": server._count})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/predict":
+                    self.send_error(404)
+                    return
+                try:
+                    req = read_json(self)
+                    x = np.asarray(req["features"], np.float32)
+                    if server._pi is not None:
+                        out = server._pi.output(x)
+                    else:
+                        out = server.net.output(x)
+                    server._count += 1
+                    write_json(self, 200, {"output": np.asarray(out).tolist()})
+                except Exception as e:
+                    write_json(self, 400, {"error": str(e)})
+
+            def log_message(self, *a):
+                pass
+
+        import http.server as hs
+        self._httpd = hs.ThreadingHTTPServer((self.host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._pi is not None:
+            self._pi.shutdown()

@@ -249,6 +249,27 @@ class TrainingUIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):  # noqa: N802 — remote stats receiver
+                # reference RemoteReceiverModule: other processes POST their
+                # stats records here (RemoteUIStatsStorageRouter client side
+                # is RemoteStatsStorageRouter in ui/storage.py)
+                if self.path != "/collect" or not server._storages:
+                    self.send_error(404)
+                    return
+                from ..util.httpjson import read_json, write_json
+                try:
+                    rec = read_json(self)
+                    store = server._storages[-1]
+                    if rec.get("kind") == "static":
+                        store.put_static_info(rec["session_id"],
+                                              rec["worker_id"], rec["data"])
+                    else:
+                        store.put_update(rec["session_id"], rec["worker_id"],
+                                         rec["data"])
+                    write_json(self, 200, {"ok": True})
+                except Exception as e:
+                    write_json(self, 400, {"error": str(e)})
+
             def log_message(self, *a):  # quiet
                 pass
 

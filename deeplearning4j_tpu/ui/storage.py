@@ -196,3 +196,84 @@ class FileStatsStorage(StatsStorage):
                if (r["kind"] == "update" and r["session"] == session_id
                    and r["worker"] == worker_id)]
         return [u for u in out if u.get("iteration", 0) > since_iteration]
+
+
+class RemoteStatsStorageRouter(StatsStorage):
+    """Client-side router POSTing every record to a remote TrainingUIServer's
+    /collect endpoint (reference core/api/storage/impl/
+    RemoteUIStatsStorageRouter.java + the Play RemoteReceiverModule, which
+    queues asynchronously with bounded retries). Writes are ASYNC: a
+    background thread drains a bounded queue with per-record retries;
+    transport failures never reach (or block) the training loop — dropped
+    records are counted in ``dropped``. ``flush()`` waits for the queue to
+    drain (tests / shutdown). Only the write half of the StatsStorage SPI is
+    functional — reads go to the server's own storage."""
+
+    def __init__(self, url: str, timeout: float = 10.0, queue_size: int = 256,
+                 max_retries: int = 3, retry_delay: float = 0.2):
+        super().__init__()
+        import queue as _queue
+        import threading as _threading
+        self.url = url.rstrip("/") + "/collect"
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_delay = retry_delay
+        self.dropped = 0
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+        self._worker = _threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self):
+        import time as _time
+        while True:
+            payload = self._q.get()
+            ok = False
+            for attempt in range(self.max_retries):
+                try:
+                    self._post(payload)
+                    ok = True
+                    break
+                except Exception:
+                    _time.sleep(self.retry_delay * (attempt + 1))
+            if not ok:
+                self.dropped += 1
+            self._q.task_done()
+
+    def _post(self, payload):
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(
+            self.url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            r.read()
+
+    def _enqueue(self, payload):
+        import queue as _queue
+        try:
+            self._q.put_nowait(payload)
+        except _queue.Full:
+            self.dropped += 1        # back-pressure: drop, never block fit()
+
+    def flush(self):
+        self._q.join()
+
+    def put_static_info(self, session_id, worker_id, info):
+        self._enqueue({"kind": "static", "session_id": session_id,
+                       "worker_id": worker_id, "data": info})
+
+    def put_update(self, session_id, worker_id, update):
+        self._enqueue({"kind": "update", "session_id": session_id,
+                       "worker_id": worker_id, "data": update})
+
+    def list_session_ids(self):
+        return []
+
+    def list_worker_ids(self, session_id):
+        return []
+
+    def get_static_info(self, session_id, worker_id):
+        return None
+
+    def get_updates(self, session_id, worker_id, since_iteration=-1):
+        return []

@@ -1,0 +1,22 @@
+"""Shared JSON plumbing for the stdlib HTTP servers (dashboard/UI receiver,
+nearest-neighbors server, model-serving route) — one copy of the
+Content-Length/read/parse/respond boilerplate."""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def read_json(handler) -> Any:
+    """Parse the JSON body of the current request (empty body -> {})."""
+    n = int(handler.headers.get("Content-Length", 0))
+    return json.loads(handler.rfile.read(n) or b"{}")
+
+
+def write_json(handler, code: int, obj: Any) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)

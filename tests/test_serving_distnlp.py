@@ -1,0 +1,174 @@
+"""Distributed Word2Vec, model-serving route, node2vec, CJK tokenizers,
+remote stats router, estimator wrappers (reference spark-nlp distributed
+training, DL4jServeRouteBuilder, node2vec stub completion, language packs,
+RemoteUIStatsStorageRouter, spark-ml wrapper)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+R = np.random.default_rng(33)
+
+
+def test_distributed_w2v_step_matches_single_device():
+    """The mesh-sharded SGNS step must equal the single-device step on the
+    same batch (identical math, sharded execution)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.distributed_w2v import DistributedWord2Vec
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+    V, D, B, k = 40, 12, 64, 5
+    syn0_np = (R.normal(size=(V, D)) * 0.1).astype(np.float32)
+    syn1_np = (R.normal(size=(V, D)) * 0.1).astype(np.float32)
+    centers = jnp.asarray(R.integers(0, V, B))
+    contexts = jnp.asarray(R.integers(0, V, B))
+    negs = jnp.asarray(R.integers(0, V, (B, k)))
+
+    # both steps donate their table buffers — hand each its own fresh arrays
+    single = SequenceVectors(layer_size=D, negative=k)._build_step()
+    s0_a, s1_a, _ = single(jnp.asarray(syn0_np), jnp.asarray(syn1_np),
+                           centers, contexts, negs, 0.05)
+
+    dist = DistributedWord2Vec(layer_size=D, negative=k)._build_step()
+    s0_b, s1_b, _ = dist(jnp.asarray(syn0_np), jnp.asarray(syn1_np),
+                         centers, contexts, negs, 0.05)
+    np.testing.assert_allclose(np.asarray(s0_b), np.asarray(s0_a), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(s1_b), np.asarray(s1_a), atol=2e-6)
+
+
+def test_distributed_w2v_end_to_end_similarity():
+    from deeplearning4j_tpu.nlp.distributed_w2v import DistributedWord2Vec
+    corpus = [("day night sun moon light dark " * 3).split()
+              for _ in range(30)] + \
+             [("cat dog pet fur paw tail " * 3).split() for _ in range(30)]
+    w2v = DistributedWord2Vec(layer_size=16, window=3, epochs=3, negative=4,
+                              seed=4, learning_rate=0.05)
+    w2v.fit(corpus)
+    assert w2v.similarity("day", "night") > w2v.similarity("day", "dog")
+
+
+def test_model_serving_server():
+    from deeplearning4j_tpu.parallel.model_server import ModelServingServer
+    conf = (NeuralNetConfiguration(seed=2, updater=Adam(5e-3), dtype="float32")
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    srv = ModelServingServer(net, batched=True)
+    port = srv.start()
+    try:
+        x = R.normal(size=(5, 4)).astype(np.float32).tolist()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"features": x}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())["output"]
+        want = np.asarray(net.output(np.asarray(x, np.float32)))
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["requests_served"] == 1
+    finally:
+        srv.stop()
+
+
+def test_node2vec_bias_and_training():
+    from deeplearning4j_tpu.graphs import Graph
+    from deeplearning4j_tpu.graphs.node2vec import (Node2Vec,
+                                                    Node2VecWalkIterator)
+    # path graph 0-1-2: from 1 after arriving from 0, returning to 0 has
+    # weight 1/p; with huge p returns are rare
+    g = Graph(3)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    returns = 0
+    total = 0
+    for s in range(60):
+        it = Node2VecWalkIterator(g, walk_length=2, p=100.0, q=1.0, seed=s)
+        for w in it:
+            if w[0] == 0 and len(w) >= 3:       # 0 -> 1 -> ?
+                total += 1
+                returns += (w[2] == 0)
+    assert total > 0
+    assert returns / total < 0.2
+
+    # two cliques embed apart (same setup as the DeepWalk test, biased walks)
+    k = 6
+    g2 = Graph(2 * k)
+    for i in range(k):
+        for j in range(i + 1, k):
+            g2.add_edge(i, j)
+            g2.add_edge(k + i, k + j)
+    g2.add_edge(0, k)
+    nv = Node2Vec(vector_size=16, window_size=4, walk_length=20,
+                  walks_per_vertex=8, epochs=3, p=1.0, q=0.5, seed=7).fit(g2)
+    same = np.mean([nv.similarity(i, j) for i in range(1, k)
+                    for j in range(1, k) if i < j])
+    cross = np.mean([nv.similarity(i, j) for i in range(1, k)
+                     for j in range(k + 1, 2 * k)])
+    assert same > cross
+
+
+def test_cjk_tokenizer():
+    from deeplearning4j_tpu.nlp.tokenizer import CJKTokenizerFactory
+    tf = CJKTokenizerFactory()
+    toks = tf.create("我爱机器学习 deep learning 딥러닝").get_tokens()
+    assert "我爱" in toks and "机器" in toks       # overlapping bigrams
+    assert "deep" in toks and "learning" in toks  # latin runs intact
+    assert "딥러닝" in toks                        # hangul run intact
+    uni = CJKTokenizerFactory(bigrams=False).create("学习").get_tokens()
+    assert uni == ["学", "习"]
+    custom = CJKTokenizerFactory(segmenter=lambda s: s.split("|"))
+    assert custom.create("a|b c|d").get_tokens() == ["a", "b c", "d"]
+
+
+def test_remote_stats_router_round_trip():
+    from deeplearning4j_tpu.ui.dashboard import TrainingUIServer
+    from deeplearning4j_tpu.ui.storage import (InMemoryStatsStorage,
+                                               RemoteStatsStorageRouter)
+    store = InMemoryStatsStorage()
+    srv = TrainingUIServer()
+    srv.attach(store)
+    port = srv.start()
+    try:
+        router = RemoteStatsStorageRouter(f"http://127.0.0.1:{port}")
+        router.put_static_info("sess1", "w0", {"model_class": "TestNet"})
+        router.put_update("sess1", "w0", {"iteration": 0, "score": 1.25})
+        router.put_update("sess1", "w0", {"iteration": 1, "score": 0.75})
+        router.flush()        # posts are async (bounded queue + retries)
+        assert router.dropped == 0
+        assert store.list_session_ids() == ["sess1"]
+        assert store.get_static_info("sess1", "w0")["model_class"] == "TestNet"
+        ups = store.get_updates("sess1", "w0")
+        assert [u["score"] for u in ups] == [1.25, 0.75]
+    finally:
+        srv.stop()
+
+
+def test_sklearn_style_wrappers():
+    from deeplearning4j_tpu.ml import NeuralNetClassifier, NeuralNetRegressor
+    x = R.normal(size=(200, 4)).astype(np.float32)
+    yi = (x[:, 0] + x[:, 1] > 0).astype(int)
+    conf = (NeuralNetConfiguration(seed=1, updater=Adam(1e-2), dtype="float32")
+            .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    clf = NeuralNetClassifier(conf, epochs=25, batch_size=64).fit(x, yi)
+    assert clf.score(x, yi) > 0.85
+    assert clf.predict_proba(x).shape == (200, 2)
+    assert clf.get_params()["epochs"] == 25
+
+    yr = (2.0 * x[:, 0] - x[:, 2]).astype(np.float32)
+    rconf = (NeuralNetConfiguration(seed=2, updater=Adam(1e-2), dtype="float32")
+             .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                   OutputLayer(n_out=1, activation="identity", loss="mse"))
+             .build())
+    reg = NeuralNetRegressor(rconf, epochs=40, batch_size=64).fit(x, yr)
+    assert reg.score(x, yr) > 0.8

@@ -18,6 +18,7 @@ class NeuralNetClassifier:
     already-built network)."""
 
     def __init__(self, conf_or_net, *, epochs: int = 10, batch_size: int = 32):
+        self.conf_or_net = conf_or_net
         self.epochs = epochs
         self.batch_size = batch_size
         if hasattr(conf_or_net, "fit"):
@@ -32,11 +33,12 @@ class NeuralNetClassifier:
         if y.ndim == 2:          # already one-hot
             self.n_classes_ = y.shape[1]
             return y.astype(np.float32)
-        classes = int(y.max()) + 1 if self.n_classes_ is None else self.n_classes_
-        self.n_classes_ = classes
-        return np.eye(classes, dtype=np.float32)[y.astype(int)]
+        self.n_classes_ = int(y.max()) + 1
+        return np.eye(self.n_classes_, dtype=np.float32)[y.astype(int)]
 
     def fit(self, X, y, **fit_kwargs):
+        # refit recomputes learned state (sklearn fit() contract)
+        self.n_classes_ = None
         Y = self._one_hot(y)
         self.net.fit(np.asarray(X, np.float32), Y, epochs=self.epochs,
                      batch_size=self.batch_size, **fit_kwargs)
@@ -56,7 +58,8 @@ class NeuralNetClassifier:
         return float((self.predict(X) == y).mean())
 
     def get_params(self, deep: bool = True):
-        return {"epochs": self.epochs, "batch_size": self.batch_size}
+        return {"conf_or_net": self.conf_or_net, "epochs": self.epochs,
+                "batch_size": self.batch_size}
 
     def set_params(self, **params):
         for k, v in params.items():

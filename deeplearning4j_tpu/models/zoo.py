@@ -33,9 +33,11 @@ def _base_builder(seed, updater, dtype="float32", **kw):
 # --------------------------------------------------------------------- ResNet50
 def _conv_bn(g: GraphBuilder, name, inp, n_out, kernel, stride, mode="same",
              relu=True):
+    # has_bias=False: the following BatchNorm's beta makes a conv bias
+    # redundant — skipping it removes a full-activation-map add per conv
     g.add_layer(f"{name}_conv", ConvolutionLayer(
-        n_out=n_out, kernel_size=kernel, stride=stride, convolution_mode=mode),
-        inp)
+        n_out=n_out, kernel_size=kernel, stride=stride, convolution_mode=mode,
+        has_bias=False), inp)
     g.add_layer(f"{name}_bn", BatchNormalization(
         activation="relu" if relu else "identity"), f"{name}_conv")
     return f"{name}_bn"

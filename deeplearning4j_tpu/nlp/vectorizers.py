@@ -124,7 +124,4 @@ class TfidfVectorizer(BagOfWordsVectorizer):
         i = self.index.get(word)
         if i is None:
             return 0.0
-        for t in self._tokens(text):
-            if t == word:
-                return float(super().transform(text)[i] * self.idf()[i])
-        return 0.0
+        return float(self.transform(text)[i])

@@ -54,6 +54,9 @@ class ConvolutionLayer(LayerConf):
     convolution_mode: str = "truncate"    # strict | truncate | same
     dilation: Tuple[int, int] = (1, 1)
     cudnn_algo_mode: Optional[str] = None  # accepted no-op (XLA autotunes; SURVEY §2.6.8)
+    # reference ConvolutionLayer hasBias; False saves the full-activation-map
+    # bias add (+ its reduce in backward) when a BatchNorm follows
+    has_bias: bool = True
 
     param_order: ClassVar[Tuple[str, ...]] = ("W", "b")
     expected_input: ClassVar[str] = "cnn"
@@ -74,7 +77,10 @@ class ConvolutionLayer(LayerConf):
         fan_in = kh * kw * c_in
         fan_out = kh * kw * self.n_out
         W = self._winit(rng, (kh, kw, c_in, self.n_out), fan_in, fan_out, dtype)
-        return {"W": W, "b": self._binit((self.n_out,), dtype)}, {}
+        params = {"W": W}
+        if self.has_bias:
+            params["b"] = self._binit((self.n_out,), dtype)
+        return params, {}
 
     def pre_output(self, params, x, *, train=False, rng=None):
         x = maybe_dropout(x, self.dropout, rng, train)
@@ -90,7 +96,7 @@ class ConvolutionLayer(LayerConf):
             x, params["W"], window_strides=(sh, sw), padding=pad,
             rhs_dilation=(dh, dw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return y + params["b"]
+        return y + params["b"] if self.has_bias else y
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return self.act(self.pre_output(params, x, train=train, rng=rng)), state

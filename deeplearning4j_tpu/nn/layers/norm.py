@@ -63,8 +63,14 @@ class BatchNormalization(LayerConf):
     def apply(self, params, state, x, *, train=False, rng=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature dim
         if train:
+            # E[x^2]-E[x]^2: both reductions fuse into ONE pass over the
+            # activation map (jnp.var re-reads x after computing the mean;
+            # flax's default use_fast_variance does the same). Cancellation
+            # can drive the difference slightly negative for large-mean/
+            # small-variance activations — clamp so rsqrt(var+eps) stays
+            # finite (precision in that regime is limited either way).
             mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            var = jnp.maximum(jnp.mean(x * x, axis=axes) - mean * mean, 0.0)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(jnp.float32),
                 "var": self.decay * state["var"] + (1 - self.decay) * var.astype(jnp.float32),

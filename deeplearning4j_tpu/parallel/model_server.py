@@ -32,6 +32,7 @@ class ModelServingServer:
         self._httpd = None
         self._thread = None
         self._count = 0
+        self._count_lock = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -63,7 +64,8 @@ class ModelServingServer:
                         out = server._pi.output(x)
                     else:
                         out = server.net.output(x)
-                    server._count += 1
+                    with server._count_lock:   # handler threads race here
+                        server._count += 1
                     write_json(self, 200, {"output": np.asarray(out).tolist()})
                 except Exception as e:
                     write_json(self, 400, {"error": str(e)})

@@ -15,30 +15,49 @@ import numpy as np
 
 class NeuralNetClassifier:
     """sklearn-style classifier around a MultiLayerConfiguration (or an
-    already-built network)."""
+    already-built network).
+
+    Clone semantics: the wrapper OWNS its network. When constructed from a
+    built net it trains a clone (warm-started from that net's weights), so
+    sklearn ``clone``/``cross_val_score`` — which reconstruct estimators via
+    ``get_params`` — get independent networks instead of sharing one set of
+    mutable weights across folds.
+    """
 
     def __init__(self, conf_or_net, *, epochs: int = 10, batch_size: int = 32):
         self.conf_or_net = conf_or_net
         self.epochs = epochs
         self.batch_size = batch_size
-        if hasattr(conf_or_net, "fit"):
-            self.net = conf_or_net
+        self._build_net()
+        self.n_classes_: Optional[int] = None
+
+    def _build_net(self):
+        src = self.conf_or_net
+        if hasattr(src, "fit"):      # built network: train an independent clone
+            self.net = src.clone() if hasattr(src, "clone") else src
         else:
             from .nn.multilayer import MultiLayerNetwork
-            self.net = MultiLayerNetwork(conf_or_net)
-        self.n_classes_: Optional[int] = None
+            self.net = MultiLayerNetwork(src)
+
+    def _output_width(self) -> Optional[int]:
+        layers = getattr(getattr(self.net, "conf", None), "layers", None)
+        if layers:
+            n = getattr(layers[-1], "n_out", None)
+            if n:
+                return int(n)
+        return None
 
     def _one_hot(self, y):
         y = np.asarray(y)
         if y.ndim == 2:          # already one-hot
             self.n_classes_ = y.shape[1]
             return y.astype(np.float32)
-        self.n_classes_ = int(y.max()) + 1
+        # width comes from the net's output layer when known, so a refit
+        # batch that happens to miss the top class still encodes correctly
+        self.n_classes_ = self._output_width() or int(y.max()) + 1
         return np.eye(self.n_classes_, dtype=np.float32)[y.astype(int)]
 
     def fit(self, X, y, **fit_kwargs):
-        # refit recomputes learned state (sklearn fit() contract)
-        self.n_classes_ = None
         Y = self._one_hot(y)
         self.net.fit(np.asarray(X, np.float32), Y, epochs=self.epochs,
                      batch_size=self.batch_size, **fit_kwargs)
@@ -64,6 +83,9 @@ class NeuralNetClassifier:
     def set_params(self, **params):
         for k, v in params.items():
             setattr(self, k, v)
+        if "conf_or_net" in params:      # new architecture -> fresh network
+            self._build_net()
+            self.n_classes_ = None
         return self
 
 

@@ -310,9 +310,12 @@ class MultiLayerNetwork:
         import copy
         other = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self.params is not None:
-            other.params = jax.tree.map(lambda a: a, self.params)
-            other.state = jax.tree.map(lambda a: a, self.state)
-            other.opt_state = jax.tree.map(lambda a: a, self.opt_state)
+            # REAL copies: the trained clone's jitted steps donate their
+            # buffers; sharing arrays would invalidate the source network
+            copy = lambda a: jnp.array(a, copy=True) if a is not None else None
+            other.params = jax.tree.map(copy, self.params)
+            other.state = jax.tree.map(copy, self.state)
+            other.opt_state = jax.tree.map(copy, self.opt_state)
         return other
 
 

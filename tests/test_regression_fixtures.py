@@ -1,0 +1,45 @@
+"""Saved-model backward-compatibility regression tests.
+
+Reference: deeplearning4j-core regressiontest/RegressionTest050/060/071/080
+— model zips produced by RELEASED versions must keep deserializing and
+predicting identically; "saved-model backward compat is a contract"
+(SURVEY.md §4). The committed fixtures under tests/fixtures/ were produced
+by this framework at config format_version 1; every future change must keep
+restoring them bit-compatibly (add new fixtures per format bump, never
+regenerate old ones).
+
+The expected outputs are CPU-pinned (conftest forces the CPU platform):
+TPU MXU f32 convolutions differ from CPU by ~1e-3 — hardware numerics, not a
+serialization regression.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util.serialization import restore_model
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.mark.parametrize("name", ["regression_v1_mln_cnn",
+                                  "regression_v1_mln_lstm",
+                                  "regression_v1_cg_merge"])
+def test_v1_fixture_restores_and_predicts_identically(name):
+    net = restore_model(os.path.join(FIXTURES, f"{name}.zip"))
+    exp = np.load(os.path.join(FIXTURES, f"{name}_expected.npz"))
+    out = np.asarray(net.output(exp["x"]))
+    np.testing.assert_allclose(out, exp["out"], atol=1e-5,
+                               err_msg=f"{name}: prediction drift after "
+                                       f"restore — saved-model compat broken")
+
+
+def test_v1_fixture_updater_state_restores():
+    net = restore_model(os.path.join(FIXTURES, "regression_v1_mln_cnn.zip"),
+                        load_updater=True)
+    assert net.opt_state is not None
+    # training continues from the restored updater state without error
+    exp = np.load(os.path.join(FIXTURES, "regression_v1_mln_cnn_expected.npz"))
+    x = exp["x"]
+    y = np.eye(3, dtype=np.float32)[np.arange(len(x)) % 3]
+    net.fit(x, y, epochs=1, batch_size=len(x))

@@ -1,0 +1,141 @@
+"""XLA-vs-reference-numpy parity tests.
+
+Reference test strategy (SURVEY.md §4): deeplearning4j-cuda's
+CuDNNGradientChecks + TestConvolution assert the ACCELERATED path equals the
+builtin path. The TPU analogue: each accelerated layer's XLA lowering is
+checked against an independent straight-loop numpy implementation — the
+"helper-with-fallback parity" discipline (SURVEY.md §2.1 L1) without
+shipping a slow fallback in the product.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers import (BatchNormalization, ConvolutionLayer,
+                                          LocalResponseNormalization, LSTM,
+                                          SubsamplingLayer)
+
+R = np.random.default_rng(77)
+
+
+def _np_conv2d_same(x, w, b, stride):
+    """Straight-loop NHWC conv, SAME padding (independent of lax.conv)."""
+    B, H, W_, C = x.shape
+    kh, kw, _, F = w.shape
+    sh, sw = stride
+    oh, ow = -(-H // sh), -(-W_ // sw)
+    pad_h = max((oh - 1) * sh + kh - H, 0)
+    pad_w = max((ow - 1) * sw + kw - W_, 0)
+    xp = np.pad(x, ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    out = np.zeros((B, oh, ow, F), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * sh:i * sh + kh, j * sw:j * sw + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [0, 1, 2]))
+    return out + (b if b is not None else 0.0)
+
+
+def test_conv2d_matches_numpy():
+    layer = ConvolutionLayer(n_in=3, n_out=5, kernel_size=(3, 3),
+                             stride=(2, 2), convolution_mode="same",
+                             activation="identity", weight_init="xavier")
+    params, _ = layer.init(jax.random.PRNGKey(0), None, jnp.float64)
+    x = R.normal(size=(2, 9, 9, 3))
+    got, _ = layer.apply(params, {}, jnp.asarray(x))
+    want = _np_conv2d_same(x, np.asarray(params["W"]),
+                           np.asarray(params["b"]), (2, 2))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-10)
+
+
+def test_conv2d_no_bias_matches_numpy():
+    layer = ConvolutionLayer(n_in=2, n_out=4, kernel_size=(3, 3),
+                             convolution_mode="same", has_bias=False,
+                             activation="identity", weight_init="xavier")
+    params, _ = layer.init(jax.random.PRNGKey(1), None, jnp.float64)
+    assert "b" not in params
+    x = R.normal(size=(2, 6, 6, 2))
+    got, _ = layer.apply(params, {}, jnp.asarray(x))
+    want = _np_conv2d_same(x, np.asarray(params["W"]), None, (1, 1))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-10)
+
+
+@pytest.mark.parametrize("pool", ["max", "avg"])
+def test_subsampling_matches_numpy(pool):
+    layer = SubsamplingLayer(pooling_type=pool, kernel_size=(2, 2),
+                             stride=(2, 2))
+    x = R.normal(size=(2, 8, 8, 3))
+    got, _ = layer.apply({}, {}, jnp.asarray(x))
+    B, H, W_, C = x.shape
+    want = np.zeros((B, H // 2, W_ // 2, C))
+    for i in range(H // 2):
+        for j in range(W_ // 2):
+            win = x[:, 2 * i:2 * i + 2, 2 * j:2 * j + 2, :]
+            want[:, i, j, :] = (win.max((1, 2)) if pool == "max"
+                                else win.mean((1, 2)))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-12)
+
+
+def test_batchnorm_matches_numpy():
+    layer = BatchNormalization(n_out=4, activation="identity")
+    params, state = layer.init(jax.random.PRNGKey(2), None, jnp.float64)
+    params = {"gamma": jnp.asarray(R.normal(size=4) + 1.0),
+              "beta": jnp.asarray(R.normal(size=4))}
+    x = R.normal(size=(6, 5, 5, 4)) * 3.0 + 1.0
+    got, new_state = layer.apply(params, state, jnp.asarray(x), train=True)
+    mean = x.mean((0, 1, 2))
+    var = x.var((0, 1, 2))
+    want = ((x - mean) / np.sqrt(var + layer.eps)) * np.asarray(params["gamma"]) \
+        + np.asarray(params["beta"])
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-8)
+    # running stats moved toward the batch stats
+    np.testing.assert_allclose(np.asarray(new_state["mean"]),
+                               (1 - layer.decay) * mean, atol=1e-5)
+
+
+def test_lrn_matches_numpy():
+    layer = LocalResponseNormalization(k=2.0, n=5, alpha=1e-4, beta=0.75)
+    x = R.normal(size=(2, 4, 4, 8))
+    got, _ = layer.apply({}, {}, jnp.asarray(x))
+    want = np.zeros_like(x)
+    half = 5 // 2
+    for c in range(8):
+        lo, hi = max(0, c - half), min(8, c + half + 1)
+        denom = (2.0 + 1e-4 * (x[..., lo:hi] ** 2).sum(-1)) ** 0.75
+        want[..., c] = x[..., c] / denom
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-10)
+
+
+def test_lstm_matches_numpy():
+    """Straight-loop LSTM recurrence vs the scan-hoisted implementation."""
+    layer = LSTM(n_in=3, n_out=4, activation="tanh", weight_init="xavier")
+    params, _ = layer.init(jax.random.PRNGKey(3), None, jnp.float64)
+    x = R.normal(size=(2, 6, 3))
+    got, _ = layer.apply(params, {}, jnp.asarray(x))
+
+    W = np.asarray(params["W"])     # [n_in, 4H]
+    Rm = np.asarray(params["R"])    # [H, 4H]
+    b = np.asarray(params["b"])     # [4H]
+    H = 4
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((2, H))
+    c = np.zeros((2, H))
+    outs = []
+    for t in range(x.shape[1]):
+        z = x[:, t] @ W + h @ Rm + b
+        # gate order must match the implementation: i, f, o, g
+        i = sigmoid(z[:, 0 * H:1 * H])
+        f = sigmoid(z[:, 1 * H:2 * H])
+        o = sigmoid(z[:, 2 * H:3 * H])
+        g = np.tanh(z[:, 3 * H:4 * H])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h.copy())
+    want = np.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-9)

@@ -146,19 +146,22 @@ def bench_reference():
     return BATCH / dt
 
 
-def bench_lstm():
-    """GravesLSTM char-RNN training tokens/sec (BASELINE #3 shape: one-hot
-    vocab ~87, seq 64, hidden 512, 2 layers)."""
+def bench_lstm(cell: str = "graves"):
+    """LSTM char-RNN training tokens/sec (BASELINE #3 shape: one-hot vocab
+    ~87, seq 64, hidden 512, 2 layers). cell='graves' (peepholes, the
+    BASELINE row) or 'plain' (standard LSTM — the apples-to-apples workload
+    for the flax-reference ratio)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
-    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, LSTM, RnnOutputLayer
     from deeplearning4j_tpu.optimize.updaters import RmsProp
 
     V, T, B, H = 87, 64, 32, 512
+    Cell = GravesLSTM if cell == "graves" else LSTM
     conf = (NeuralNetConfiguration(seed=1, updater=RmsProp(1e-3), dtype="float32")
-            .list(GravesLSTM(n_out=H, activation="tanh"),
-                  GravesLSTM(n_out=H, activation="tanh"),
+            .list(Cell(n_out=H, activation="tanh"),
+                  Cell(n_out=H, activation="tanh"),
                   RnnOutputLayer(n_out=V, activation="softmax", loss="mcxent"))
             .set_input_type(InputType.recurrent(V, T)).build())
     net = MultiLayerNetwork(conf).init()
@@ -178,6 +181,47 @@ def bench_lstm():
     dt = _time_steps(step, [net.params, net.state, net.opt_state,
                             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
                      STEPS)
+    return B * T / dt
+
+
+def bench_lstm_reference():
+    """Independent flax.linen 2-layer LSTM char-RNN + optax rmsprop, same
+    shapes as bench_lstm (V=87, T=64, B=32, H=512) — the tokens/sec
+    comparison point."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    import optax
+
+    V, T, B, H = 87, 64, 32, 512
+
+    class CharRNN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.RNN(nn.OptimizedLSTMCell(H))(x)
+            x = nn.RNN(nn.OptimizedLSTMCell(H))(x)
+            return nn.Dense(V)(x)
+
+    model = CharRNN()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, T))
+    x = jnp.asarray(np.eye(V, dtype=np.float32)[ids])
+    labels = jnp.asarray(np.roll(ids, -1, axis=1))
+    params = model.init(jax.random.PRNGKey(0), x)
+    tx = optax.rmsprop(1e-3)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        def lf(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    dt = _time_steps(step, [params, opt_state], STEPS)
     return B * T / dt
 
 
@@ -284,7 +328,22 @@ print(json.dumps({"x1": one, "x8": eight, "eff": eight / (8 * one)}))
     return json.loads(lines[-1])
 
 
+def _global_warmup(seconds: float = 5.0):
+    """Spin the chip to steady clocks before the first measurement — the
+    first jitted program in a cold process otherwise under-reports by
+    tens of percent (observed on v5e)."""
+    import jax
+    import jax.numpy as jnp
+    a = jnp.ones((2048, 2048), jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        a = f(a)
+    jax.block_until_ready(a)
+
+
 def main():
+    _global_warmup()
     ours = bench_ours()
     try:
         ref = bench_reference()
@@ -298,6 +357,8 @@ def main():
         for name, fn in [
             ("resnet50_bf16_img_per_sec", lambda: bench_ours(dtype="bfloat16")),
             ("lstm_train_tokens_per_sec", bench_lstm),
+            ("lstm_plain_tokens_per_sec", lambda: bench_lstm(cell="plain")),
+            ("lstm_reference_tokens_per_sec", bench_lstm_reference),
             ("word2vec_words_per_sec", bench_word2vec),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("dp_scaling_efficiency_8dev", bench_dp_scaling),
@@ -308,6 +369,12 @@ def main():
             except Exception as e:
                 print(f"extra bench {name} failed: {e}", file=sys.stderr)
                 extras[name] = None
+        if extras.get("lstm_plain_tokens_per_sec") and \
+                extras.get("lstm_reference_tokens_per_sec"):
+            # plain-vs-plain: both sides are standard (no-peephole) LSTMs
+            extras["lstm_vs_reference"] = round(
+                extras["lstm_plain_tokens_per_sec"]
+                / extras["lstm_reference_tokens_per_sec"], 3)
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",

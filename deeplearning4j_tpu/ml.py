@@ -34,7 +34,12 @@ class NeuralNetClassifier:
     def _build_net(self):
         src = self.conf_or_net
         if hasattr(src, "fit"):      # built network: train an independent clone
-            self.net = src.clone() if hasattr(src, "clone") else src
+            if not hasattr(src, "clone"):
+                raise ValueError(
+                    "Wrapped networks must support clone() so the estimator "
+                    "owns independent weights (sklearn clone/cross-val would "
+                    "otherwise share one mutable network across folds)")
+            self.net = src.clone()
         else:
             from .nn.multilayer import MultiLayerNetwork
             self.net = MultiLayerNetwork(src)
@@ -58,6 +63,9 @@ class NeuralNetClassifier:
         return np.eye(self.n_classes_, dtype=np.float32)[y.astype(int)]
 
     def fit(self, X, y, **fit_kwargs):
+        # sklearn fit() contract: every fit restarts from the construction
+        # point (fresh init from a conf, or a fresh clone of the source net)
+        self._build_net()
         Y = self._one_hot(y)
         self.net.fit(np.asarray(X, np.float32), Y, epochs=self.epochs,
                      batch_size=self.batch_size, **fit_kwargs)
@@ -93,6 +101,7 @@ class NeuralNetRegressor(NeuralNetClassifier):
     """sklearn-style regressor: targets pass through; score is R^2."""
 
     def fit(self, X, y, **fit_kwargs):
+        self._build_net()
         y = np.asarray(y, np.float32)
         if y.ndim == 1:
             y = y[:, None]

@@ -74,7 +74,10 @@ def _ring_body(q, k0, v0, axis, n, causal, scale, t_local):
     idx = jax.lax.axis_index(axis)
     B, H, Tq, D = q.shape
 
-    def step(j, carry):
+    def step(carry, j):
+        # lax.scan (not fori_loop): scan has a reverse-mode rule, so the ring
+        # is TRAINABLE — jax.grad re-runs the ring backwards with the same
+        # ppermute traffic pattern
         acc, m, l, k, v = carry
         src = (idx - j) % n          # which device's k/v block we hold now
         mask = None
@@ -86,12 +89,13 @@ def _ring_body(q, k0, v0, axis, n, causal, scale, t_local):
         perm = [(i, (i + 1) % n) for i in range(n)]
         k = jax.lax.ppermute(k, axis, perm)
         v = jax.lax.ppermute(v, axis, perm)
-        return acc, m, l, k, v
+        return (acc, m, l, k, v), None
 
     acc = jnp.zeros(q.shape, q.dtype)
     m = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
     l = jnp.zeros((B, H, Tq), q.dtype)
-    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc, m, l, k0, v0))
+    (acc, m, l, _, _), _ = jax.lax.scan(step, (acc, m, l, k0, v0),
+                                        jnp.arange(n))
     return acc / jnp.maximum(l, 1e-20)[..., None]
 
 

@@ -100,3 +100,25 @@ def test_attention_classifier_trains():
     s0 = net.score(x, y)
     net.fit(x, y, epochs=20, batch_size=32)
     assert net.score(x, y) < s0
+
+
+def test_ring_attention_is_trainable():
+    """Gradients flow through the ring (lax.scan, not fori_loop): the
+    sharded backward must match single-device full-attention gradients."""
+    mesh = make_mesh((8,), ("seq",))
+    q, k, v = _qkv(B=1, H=2, T=16, D=4)
+    fn = ring_attention_sharded(mesh, "seq", causal=True)
+    sh = sequence_sharding(mesh, "seq")
+
+    def ring_loss(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def full_loss(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(qs, ks, vs)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(jax.device_get(gr)),
+                                   np.asarray(gf), atol=5e-5)

@@ -8,6 +8,9 @@ device/batch/dtype — target >= 0.70x (vs_baseline = ours/reference).
 The same line carries an ``extras`` dict with the remaining BASELINE rows:
   - resnet50_bf16_img_per_sec      ResNet-50, bfloat16 params+data
   - lstm_train_tokens_per_sec      GravesLSTM char-RNN (BASELINE #3)
+  - lstm_plain_tokens_per_sec      plain (no-peephole) LSTM, same shapes
+  - lstm_reference_tokens_per_sec  independent flax OptimizedLSTMCell char-RNN
+  - lstm_vs_reference              plain / reference (apples-to-apples ratio)
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE #4)
   - dp_scaling_efficiency_8dev     ParallelWrapper on the 8-device virtual CPU
                                    mesh (BASELINE #5; chips unavailable, so

@@ -28,7 +28,7 @@ class NeuralNetClassifier:
         self.conf_or_net = conf_or_net
         self.epochs = epochs
         self.batch_size = batch_size
-        self._build_net()
+        self.net = None          # built lazily by fit() (no wasted clone)
         self.n_classes_: Optional[int] = None
 
     def _build_net(self):
@@ -72,6 +72,8 @@ class NeuralNetClassifier:
         return self
 
     def predict_proba(self, X) -> np.ndarray:
+        if self.net is None:
+            raise ValueError("This estimator is not fitted yet; call fit() first")
         return np.asarray(self.net.output(np.asarray(X, np.float32)))
 
     def predict(self, X) -> np.ndarray:
@@ -91,8 +93,8 @@ class NeuralNetClassifier:
     def set_params(self, **params):
         for k, v in params.items():
             setattr(self, k, v)
-        if "conf_or_net" in params:      # new architecture -> fresh network
-            self._build_net()
+        if "conf_or_net" in params:      # new architecture -> refit required
+            self.net = None
             self.n_classes_ = None
         return self
 
@@ -110,6 +112,8 @@ class NeuralNetRegressor(NeuralNetClassifier):
         return self
 
     def predict(self, X) -> np.ndarray:
+        if self.net is None:
+            raise ValueError("This estimator is not fitted yet; call fit() first")
         out = np.asarray(self.net.output(np.asarray(X, np.float32)))
         return out[:, 0] if out.shape[-1] == 1 else out
 

@@ -1,0 +1,78 @@
+"""Expert parallelism: mixture-of-experts dispatch over a mesh axis.
+
+NET-NEW capability beyond reference parity (SURVEY.md §2.2: the reference
+has no expert parallelism). Experts are sharded over the ``expert`` mesh
+axis (each device holds n_experts/n_devices expert parameter sets); tokens
+are routed to their top-1 expert with capacity-bounded dispatch and exchanged
+via ``all_to_all`` — the canonical TPU MoE pattern (dispatch/combine
+einsums + ICI all-to-all).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def expert_parallel_apply(expert_fn: Callable, mesh: Mesh,
+                          axis: str = "expert", capacity_factor: float = 2.0):
+    """Build ``fn(stacked_expert_params, tokens, gate_logits)``.
+
+    - ``expert_fn(params_e, x) -> y``: one expert's computation ([T, D] in,
+      [T, D'] out, shape-static).
+    - ``stacked_expert_params``: leaves with leading ``n_experts`` axis,
+      sharded on ``axis`` (one expert per device in this implementation:
+      n_experts == mesh.shape[axis]).
+    - ``tokens``: [N, D] replicated; ``gate_logits``: [N, n_experts].
+
+    Top-1 routing with per-expert capacity C = ceil(capacity_factor * N /
+    n_experts); overflow tokens are dropped (standard MoE semantics) and
+    pass through as zeros, weighted combine restores gate probabilities.
+    """
+    n = int(mesh.shape[axis])
+
+    def worker(params, tokens, gate_logits):
+        params = jax.tree.map(lambda a: a[0], params)   # this device's expert
+        N, D = tokens.shape
+        cap = int(np.ceil(capacity_factor * N / n))
+        probs = jax.nn.softmax(gate_logits, axis=-1)    # [N, E]
+        choice = jnp.argmax(probs, axis=-1)             # [N]
+        gate = jnp.max(probs, axis=-1)                  # [N]
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(choice, n, dtype=jnp.int32)      # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
+        pos_in_expert = jnp.sum(pos, axis=-1) - 1                # [N]
+        keep = pos_in_expert < cap
+        # dispatch buffer [E, cap, D] built identically on every device
+        disp = jnp.zeros((n, cap, D), tokens.dtype)
+        disp = disp.at[choice, jnp.clip(pos_in_expert, 0, cap - 1)].add(
+            tokens * keep[:, None])
+        # all_to_all is unnecessary here because every device computed the
+        # full dispatch; each device SELECTS its expert's slab. (With
+        # token-sharded inputs this becomes a real all_to_all; the combine
+        # below is the psum half of that exchange.)
+        idx = jax.lax.axis_index(axis)
+        my_slab = disp[idx]                              # [cap, D]
+        my_out = expert_fn(params, my_slab)              # [cap, D']
+        # combine: scatter my expert's outputs back to token order, psum
+        # across experts
+        token_idx = jnp.arange(N)
+        mine = jnp.logical_and(choice == idx, keep)
+        out = jnp.zeros((N, my_out.shape[-1]), my_out.dtype)
+        out = out.at[token_idx].add(
+            my_out[jnp.clip(pos_in_expert, 0, cap - 1)] * mine[:, None])
+        out = jax.lax.psum(out, axis)
+        return out * gate[:, None]
+
+    fn = shard_map(worker, mesh=mesh,
+                   in_specs=(P(axis), P(), P()), out_specs=P(),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def expert_sharding(mesh: Mesh, axis: str = "expert") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,90 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over a mesh
+axis.
+
+NET-NEW capability beyond reference parity (SURVEY.md §2.2 records the
+reference has data parallelism only; TP/PP/SP are the TPU-idiomatic
+extensions the survey directs to build on GSPMD/shard_map meshes).
+
+The practical pipeline case is a deep stack of IDENTICAL blocks (transformer
+/ recurrent stacks): block parameters are STACKED on a leading stage axis and
+sharded over the ``pipe`` mesh axis, so each device holds 1/n of the
+parameters — the actual memory win of pipeline parallelism. Microbatches
+stream through the classic GPipe schedule: at tick t, stage s processes
+microbatch (t - s); activations hop stage-to-stage via ``ppermute`` (ICI
+neighbor traffic) inside one ``lax.scan``. Forward is differentiable (scan +
+ppermute both have transpose rules), so ``jax.grad`` of a pipelined loss
+yields the standard GPipe backward schedule for free.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(block_fn: Callable, mesh: Mesh, axis: str = "pipe"):
+    """Build a pipelined apply: ``fn(stacked_params, x_micro)``.
+
+    - ``block_fn(params_i, x) -> y``: one stage's computation; all stages
+      share this structure (x and y must have identical shapes).
+    - ``stacked_params``: pytree whose leaves have a leading ``n_stages``
+      axis, sharded on ``axis`` (use :func:`stage_sharding`).
+    - ``x_micro``: [n_micro, micro_batch, ...] microbatches (replicated).
+
+    Returns [n_micro, micro_batch, ...] outputs after all stages. Semantics
+    identical to applying the n blocks sequentially to each microbatch.
+    """
+    n = int(mesh.shape[axis])
+
+    def worker(params, x_micro):
+        # params: this stage's block params (leading stage axis stripped to 1)
+        params = jax.tree.map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = x_micro.shape[0]
+        ticks = n_micro + n - 1
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        buf = jnp.zeros_like(x_micro[0])      # activation entering this stage
+        outs = jnp.zeros_like(x_micro)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 injects microbatch t from the input stream
+            inject = jnp.where(t < n_micro, t, 0)
+            x_in = jnp.where(stage == 0, x_micro[inject], buf)
+            y = block_fn(params, x_in)
+            # last stage emits microbatch (t - (n-1)) into the output stream
+            emit_idx = t - (n - 1)
+            valid = jnp.logical_and(stage == n - 1, emit_idx >= 0)
+            outs = jax.lax.cond(
+                valid,
+                lambda o: o.at[jnp.maximum(emit_idx, 0)].set(y),
+                lambda o: o, outs)
+            # activations hop to the next stage
+            buf = jax.lax.ppermute(y, axis, perm)
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(ticks))
+        # outputs live on the LAST stage; share them with every stage so the
+        # result is replicated (psum of one-hot contribution)
+        outs = jax.lax.psum(jnp.where(stage == n - 1, outs, 0.0), axis)
+        return outs
+
+    fn = shard_map(worker, mesh=mesh,
+                   in_specs=(P(axis), P()), out_specs=P(),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def stage_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:
+    """Sharding for stacked per-stage parameters: leading axis on ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def stack_stage_params(param_list) -> dict:
+    """Stack a list of per-stage param pytrees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

@@ -1,0 +1,122 @@
+"""Pipeline + expert parallelism (net-new mesh-axis capabilities; SURVEY.md
+§2.2 extension beyond the reference's DP-only story)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.expert_parallel import (expert_parallel_apply,
+                                                         expert_sharding)
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.pipeline import (pipeline_apply,
+                                                  stack_stage_params,
+                                                  stage_sharding)
+
+R = np.random.default_rng(47)
+
+
+def _block(params, x):
+    return jnp.tanh(x @ params["W"] + params["b"])
+
+
+def _make_stage_params(n, d, scale=0.4):
+    return [{"W": jnp.asarray(R.normal(size=(d, d)).astype(np.float32) * scale),
+             "b": jnp.asarray(R.normal(size=(d,)).astype(np.float32) * 0.1)}
+            for _ in range(n)]
+
+
+def test_pipeline_matches_sequential():
+    """8-stage pipeline over microbatches == applying the 8 blocks in
+    sequence to each microbatch."""
+    mesh = make_mesh((8,), ("pipe",))
+    d, n_micro, mb = 6, 5, 4
+    stages = _make_stage_params(8, d)
+    stacked = jax.device_put(stack_stage_params(stages),
+                             stage_sharding(mesh, "pipe"))
+    x = jnp.asarray(R.normal(size=(n_micro, mb, d)).astype(np.float32))
+
+    fn = pipeline_apply(_block, mesh, "pipe")
+    got = np.asarray(jax.device_get(fn(stacked, x)))
+
+    want = np.asarray(x)
+    for p in stages:
+        want = np.tanh(want @ np.asarray(p["W"]) + np.asarray(p["b"]))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_pipeline_is_differentiable():
+    """jax.grad through the pipelined forward equals grad of the sequential
+    composition (scan+ppermute transpose = the GPipe backward schedule)."""
+    mesh = make_mesh((8,), ("pipe",))
+    d = 4
+    stages = _make_stage_params(8, d)
+    stacked = jax.device_put(stack_stage_params(stages),
+                             stage_sharding(mesh, "pipe"))
+    x = jnp.asarray(R.normal(size=(3, 2, d)).astype(np.float32))
+    fn = pipeline_apply(_block, mesh, "pipe")
+
+    g_pipe = jax.grad(lambda p: jnp.sum(fn(p, x) ** 2))(stacked)
+
+    def seq_loss(plist):
+        y = x
+        for p in plist:
+            y = jnp.tanh(y @ p["W"] + p["b"])
+        return jnp.sum(y ** 2)
+
+    g_seq = jax.grad(seq_loss)(stages)
+    for i in range(8):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(g_pipe["W"]))[i],
+            np.asarray(g_seq[i]["W"]), atol=3e-4)
+
+
+def test_pipeline_parameters_are_sharded():
+    mesh = make_mesh((8,), ("pipe",))
+    stacked = jax.device_put(stack_stage_params(_make_stage_params(8, 4)),
+                             stage_sharding(mesh, "pipe"))
+    # each device holds exactly one stage's W
+    assert stacked["W"].sharding.spec[0] == "pipe"
+    shard = stacked["W"].addressable_shards[0]
+    assert shard.data.shape == (1, 4, 4)
+
+
+def test_expert_parallel_matches_dense_top1():
+    """8-expert EP == dense per-token top-1 expert evaluation (capacity
+    large enough that nothing is dropped)."""
+    mesh = make_mesh((8,), ("expert",))
+    d, N = 6, 32
+    experts = _make_stage_params(8, d)
+    stacked = jax.device_put(stack_stage_params(experts),
+                             expert_sharding(mesh, "expert"))
+    tokens = jnp.asarray(R.normal(size=(N, d)).astype(np.float32))
+    logits = jnp.asarray(R.normal(size=(N, 8)).astype(np.float32))
+
+    fn = expert_parallel_apply(_block, mesh, "expert", capacity_factor=8.0)
+    got = np.asarray(jax.device_get(fn(stacked, tokens, logits)))
+
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    choice = probs.argmax(-1)
+    gate = probs.max(-1)
+    want = np.zeros((N, d), np.float32)
+    for i in range(N):
+        e = experts[choice[i]]
+        want[i] = np.tanh(np.asarray(tokens[i]) @ np.asarray(e["W"])
+                          + np.asarray(e["b"])) * gate[i]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_expert_parallel_capacity_drops_overflow():
+    """With capacity 1 per expert and all tokens routed to expert 0, only
+    the first token gets computed; the rest pass through as zeros."""
+    mesh = make_mesh((8,), ("expert",))
+    d, N = 4, 8
+    experts = _make_stage_params(8, d)
+    stacked = jax.device_put(stack_stage_params(experts),
+                             expert_sharding(mesh, "expert"))
+    tokens = jnp.asarray(R.normal(size=(N, d)).astype(np.float32))
+    logits = jnp.full((N, 8), -10.0).at[:, 0].set(10.0)  # everyone -> expert 0
+
+    fn = expert_parallel_apply(_block, mesh, "expert", capacity_factor=0.125)
+    out = np.asarray(jax.device_get(fn(stacked, tokens, jnp.asarray(logits))))
+    assert np.abs(out[0]).sum() > 0          # first token served
+    np.testing.assert_allclose(out[1:], 0.0, atol=1e-7)  # overflow dropped

@@ -71,10 +71,19 @@ class NeuralNetClassifier:
                      batch_size=self.batch_size, **fit_kwargs)
         return self
 
-    def predict_proba(self, X) -> np.ndarray:
+    def _require_net(self):
         if self.net is None:
-            raise ValueError("This estimator is not fitted yet; call fit() first")
-        return np.asarray(self.net.output(np.asarray(X, np.float32)))
+            if hasattr(self.conf_or_net, "fit"):
+                # wrapped pre-trained network: inference without fit() is
+                # legitimate — build the owned clone lazily
+                self._build_net()
+            else:
+                raise ValueError(
+                    "This estimator is not fitted yet; call fit() first")
+        return self.net
+
+    def predict_proba(self, X) -> np.ndarray:
+        return np.asarray(self._require_net().output(np.asarray(X, np.float32)))
 
     def predict(self, X) -> np.ndarray:
         return self.predict_proba(X).argmax(-1)
@@ -112,9 +121,7 @@ class NeuralNetRegressor(NeuralNetClassifier):
         return self
 
     def predict(self, X) -> np.ndarray:
-        if self.net is None:
-            raise ValueError("This estimator is not fitted yet; call fit() first")
-        out = np.asarray(self.net.output(np.asarray(X, np.float32)))
+        out = np.asarray(self._require_net().output(np.asarray(X, np.float32)))
         return out[:, 0] if out.shape[-1] == 1 else out
 
     def score(self, X, y) -> float:

@@ -297,3 +297,34 @@ def text_generation_lstm(vocab_size: int = 77, *, hidden: int = 256,
          .set_input_type(InputType.recurrent(vocab_size, max_length))
          .tbptt_length(tbptt_length))
     return MultiLayerNetwork(b.build())
+
+
+def sample_text(net, *, vocab_size: int, seed_ids, n_steps: int,
+                temperature: float = 1.0, rng_seed: int = 0):
+    """Generate a token-id sequence from a trained TextGenerationLSTM via the
+    streaming ``rnn_time_step`` API (the reference zoo model's sampling use
+    case; GravesLSTMCharModellingExample-style temperature sampling).
+
+    ``seed_ids``: iterable of int token ids used to prime the recurrent
+    state; returns a list of ``n_steps`` sampled ids (softmax output is
+    re-tempered: p_i ∝ p_i^(1/T))."""
+    import numpy as np
+    rng = np.random.default_rng(rng_seed)
+    net.rnn_clear_previous_state()
+    probs = None
+    for t in seed_ids:
+        x = np.zeros((1, vocab_size), np.float32)
+        x[0, int(t)] = 1.0
+        probs = np.asarray(net.rnn_time_step(x))[0]
+    out = []
+    for _ in range(n_steps):
+        if probs is None:
+            probs = np.full(vocab_size, 1.0 / vocab_size)
+        p = np.clip(probs, 1e-12, None) ** (1.0 / max(temperature, 1e-6))
+        p /= p.sum()
+        nxt = int(rng.choice(vocab_size, p=p))
+        out.append(nxt)
+        x = np.zeros((1, vocab_size), np.float32)
+        x[0, nxt] = 1.0
+        probs = np.asarray(net.rnn_time_step(x))[0]
+    return out

@@ -41,6 +41,11 @@ class MultiLayerConfiguration:
     # LINE_GRADIENT_DESCENT, CONJUGATE_GRADIENT, LBFGS
     optimization_algorithm: str = "sgd"
     max_num_line_search_iterations: int = 5
+    # jax.checkpoint each layer's forward: activations are re-computed in the
+    # backward pass instead of stored — trades FLOPs for HBM (the TPU
+    # replacement for the reference's activation-caching knobs; deep stacks /
+    # long sequences fit in memory at ~1.3x step cost)
+    gradient_checkpointing: bool = False
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -74,7 +79,8 @@ class NeuralNetConfiguration:
                  gradient_normalization: Optional[str] = None,
                  gradient_normalization_threshold: float = 1.0,
                  dtype: str = "float32", optimization_algorithm: str = "sgd",
-                 max_num_line_search_iterations: int = 5, **workspace_noops):
+                 max_num_line_search_iterations: int = 5,
+                 gradient_checkpointing: bool = False, **workspace_noops):
         if updater is None:
             updater = Sgd(learning_rate=learning_rate if learning_rate is not None else 0.1)
         elif isinstance(updater, str):
@@ -97,6 +103,7 @@ class NeuralNetConfiguration:
         self.dtype = dtype
         self.optimization_algorithm = optimization_algorithm.lower()
         self.max_num_line_search_iterations = max_num_line_search_iterations
+        self.gradient_checkpointing = gradient_checkpointing
 
     # --- cascade (reference :604-608): fill None fields from globals ---
     def _cascade(self, layer):
@@ -201,7 +208,8 @@ class ListBuilder:
             gradient_normalization_threshold=nc.gradient_normalization_threshold,
             updater=nc.updater,
             optimization_algorithm=nc.optimization_algorithm,
-            max_num_line_search_iterations=nc.max_num_line_search_iterations)
+            max_num_line_search_iterations=nc.max_num_line_search_iterations,
+            gradient_checkpointing=nc.gradient_checkpointing)
 
 
 def _infer_n_in(layer, itype):

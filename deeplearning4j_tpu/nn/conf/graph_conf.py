@@ -40,6 +40,7 @@ class ComputationGraphConfiguration:
     # reference nn/api/OptimizationAlgorithm.java:27 (see config.py)
     optimization_algorithm: str = "sgd"
     max_num_line_search_iterations: int = 5
+    gradient_checkpointing: bool = False   # see MultiLayerConfiguration
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -178,4 +179,5 @@ class GraphBuilder:
             gradient_normalization_threshold=nc.gradient_normalization_threshold,
             updater=nc.updater,
             optimization_algorithm=nc.optimization_algorithm,
-            max_num_line_search_iterations=nc.max_num_line_search_iterations)
+            max_num_line_search_iterations=nc.max_num_line_search_iterations,
+            gradient_checkpointing=nc.gradient_checkpointing)

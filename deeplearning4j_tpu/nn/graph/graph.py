@@ -128,6 +128,12 @@ class ComputationGraph:
                     mask=in_mask, initial_state=init)
                 s = state[idx]
                 rnn_out[idx] = final
+            elif isinstance(v, LayerVertex) and \
+                    getattr(self.conf, "gradient_checkpointing", False):
+                fn = jax.checkpoint(
+                    lambda p, s_, xx, key, _v=v, _m=in_mask:
+                    _v.apply(p, s_, xx, train=train, rng=key, mask=_m))
+                out, s = fn(params[idx], state[idx], vin, sub)
             elif isinstance(v, LayerVertex):
                 out, s = v.apply(params[idx], state[idx], vin, train=train,
                                  rng=sub, mask=in_mask)

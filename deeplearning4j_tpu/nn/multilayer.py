@@ -112,6 +112,14 @@ class MultiLayerNetwork:
                     initial_state=init, **kwargs)
                 s = state[i]
                 rnn_out[i] = final
+            elif getattr(self.conf, "gradient_checkpointing", False):
+                # remat: recompute this layer's activations in the backward
+                # pass instead of storing them (HBM for FLOPs; the TPU
+                # replacement for the reference's CacheMode knobs)
+                fn = jax.checkpoint(
+                    lambda p, s_, xx, key, _l=layer, _kw=kwargs:
+                    _l.apply(p, s_, xx, train=train, rng=key, **_kw))
+                x, s = fn(params[i], state[i], x, sub)
             else:
                 x, s = layer.apply(params[i], state[i], x, train=train, rng=sub,
                                    **kwargs)

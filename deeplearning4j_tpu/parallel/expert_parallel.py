@@ -68,10 +68,24 @@ def expert_parallel_apply(expert_fn: Callable, mesh: Mesh,
         out = jax.lax.psum(out, axis)
         return out * gate[:, None]
 
-    fn = shard_map(worker, mesh=mesh,
-                   in_specs=(P(axis), P(), P()), out_specs=P(),
-                   check_vma=False)
-    return jax.jit(fn)
+    inner = jax.jit(shard_map(worker, mesh=mesh,
+                              in_specs=(P(axis), P(), P()), out_specs=P(),
+                              check_vma=False))
+
+    def fn(stacked_params, tokens, gate_logits):
+        if gate_logits.shape[-1] != n:
+            raise ValueError(
+                f"gate_logits last dim ({gate_logits.shape[-1]}) must equal "
+                f"the expert mesh axis size ({n}) — routing to a nonexistent "
+                f"expert would silently zero those tokens")
+        for leaf in jax.tree.leaves(stacked_params):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"stacked expert params must have leading dim == mesh "
+                    f"axis size ({n}); got {leaf.shape[0]}")
+        return inner(stacked_params, tokens, gate_logits)
+
+    return fn
 
 
 def expert_sharding(mesh: Mesh, axis: str = "expert") -> NamedSharding:

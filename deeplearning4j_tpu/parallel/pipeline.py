@@ -41,6 +41,14 @@ def pipeline_apply(block_fn: Callable, mesh: Mesh, axis: str = "pipe"):
     """
     n = int(mesh.shape[axis])
 
+    def _validate(stacked_params):
+        for leaf in jax.tree.leaves(stacked_params):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"stacked stage params must have leading dim == mesh "
+                    f"axis size ({n}); got {leaf.shape[0]} — one stage per "
+                    f"device (each worker strips its own stage)")
+
     def worker(params, x_micro):
         # params: this stage's block params (leading stage axis stripped to 1)
         params = jax.tree.map(lambda a: a[0], params)
@@ -74,10 +82,15 @@ def pipeline_apply(block_fn: Callable, mesh: Mesh, axis: str = "pipe"):
         outs = jax.lax.psum(jnp.where(stage == n - 1, outs, 0.0), axis)
         return outs
 
-    fn = shard_map(worker, mesh=mesh,
-                   in_specs=(P(axis), P()), out_specs=P(),
-                   check_vma=False)
-    return jax.jit(fn)
+    inner = jax.jit(shard_map(worker, mesh=mesh,
+                              in_specs=(P(axis), P()), out_specs=P(),
+                              check_vma=False))
+
+    def fn(stacked_params, x_micro):
+        _validate(stacked_params)
+        return inner(stacked_params, x_micro)
+
+    return fn
 
 
 def stage_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:

@@ -1,0 +1,59 @@
+"""Gradient checkpointing (rematerialization): flag-on outputs and gradients
+must equal flag-off (jax.checkpoint trades FLOPs for HBM without changing
+math)."""
+import numpy as np
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          GravesLSTM, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+R = np.random.default_rng(51)
+
+
+def test_mln_remat_matches_plain():
+    def build(remat):
+        conf = (NeuralNetConfiguration(seed=5, updater=Sgd(0.1), dtype="float32",
+                                       gradient_checkpointing=remat)
+                .list(DenseLayer(n_in=6, n_out=16, activation="tanh"),
+                      DenseLayer(n_out=16, activation="relu"),
+                      OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    a, b = build(False), build(True)
+    b.set_params_flat(a.params_flat())
+    x = R.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[R.integers(0, 3, 16)]
+    np.testing.assert_allclose(np.asarray(a.output(x)), np.asarray(b.output(x)),
+                               atol=1e-6)
+    a.fit(x, y, epochs=3, batch_size=16)
+    b.fit(x, y, epochs=3, batch_size=16)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=1e-5)
+
+
+def test_cg_remat_matches_plain():
+    def build(remat):
+        g = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1), dtype="float32",
+                                    gradient_checkpointing=remat)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("l1", GravesLSTM(n_out=8, activation="tanh"), "in")
+             .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "l1")
+             .set_outputs("out")
+             .set_input_types(InputType.recurrent(3, 6)))
+        return ComputationGraph(g.build()).init()
+
+    a, b = build(False), build(True)
+    b.set_params_flat(a.params_flat())
+    x = R.normal(size=(4, 6, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[R.integers(0, 2, (4, 6))]
+    a.fit(x, y, epochs=3, batch_size=4)
+    b.fit(x, y, epochs=3, batch_size=4)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=1e-5)

@@ -219,3 +219,25 @@ def test_pretrained_cache_checksum_and_load(tmp_path):
     with pytest.raises(ValueError, match="params"):
         init_pretrained(MultiLayerNetwork(conf2).init(), src, checksum=csum,
                         cache_dir=cache)
+
+
+def test_text_generation_sampling():
+    """Streaming temperature sampling off a trained char model (reference
+    TextGenerationLSTM's use case)."""
+    from deeplearning4j_tpu.models.zoo_extra import sample_text
+    V = 8
+    net = text_generation_lstm(vocab_size=V, max_length=16, hidden=32,
+                               tbptt_length=8, updater=Adam(1e-2)).init()
+    # teach a trivial cycle 0->1->2->...->0 from every phase offset
+    ids = (np.arange(V)[:, None] + np.arange(17)[None, :]) % V   # [V, 17]
+    x = np.eye(V, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[ids[:, 1:]]
+    net.fit(x, y, epochs=120, batch_size=V)
+    out = sample_text(net, vocab_size=V, seed_ids=[0, 1, 2], n_steps=10,
+                      temperature=0.1, rng_seed=3)
+    assert len(out) == 10
+    assert all(0 <= t < V for t in out)
+    # low temperature on a learned cycle: most transitions follow +1 mod V
+    seq = [2] + out
+    follows = sum(1 for a, b in zip(seq, seq[1:]) if b == (a + 1) % V)
+    assert follows >= 6, (seq, follows)

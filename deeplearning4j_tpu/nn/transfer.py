@@ -37,16 +37,21 @@ class FineTuneConfiguration:
     activation: Optional[str] = None
     seed: Optional[int] = None
 
+    def apply_to_layer(self, layer_conf):
+        """Per-layer half of the override (shared by the MLN and CG
+        builders)."""
+        for f in ("learning_rate", "l1", "l2", "dropout", "activation"):
+            v = getattr(self, f)
+            if v is not None:
+                setattr(layer_conf, f, v)
+
     def apply_to(self, conf: MultiLayerConfiguration):
         if self.updater is not None:
             conf.updater = self.updater
         if self.seed is not None:
             conf.seed = self.seed
         for layer in conf.layers:
-            for f in ("learning_rate", "l1", "l2", "dropout", "activation"):
-                v = getattr(self, f)
-                if v is not None:
-                    setattr(layer, f, v)
+            self.apply_to_layer(layer)
 
 
 class TransferLearning:
@@ -190,3 +195,110 @@ class TransferLearningHelper:
         tail.state = tuple(self.net.state[cut:])
         tail.opt_state = tail.updater.init(tail.params)
         return tail
+
+
+class TransferLearningGraph:
+    """Transfer learning over a trained ComputationGraph (reference
+    TransferLearning.GraphBuilder inner class, TransferLearning.java:
+    setFeatureExtractor freezes a vertex and everything upstream of it,
+    nOutReplace re-initializes a layer vertex and the direct LayerVertex
+    consumers whose fan-in changes, fineTuneConfiguration overrides
+    hyperparameters). Surviving vertices keep their trained parameters;
+    re-initialized ones get fresh init; the updater state restarts.
+    """
+
+    def __init__(self, net):
+        self._net = net
+        self._freeze_at: Optional[str] = None
+        self._replace: Dict[str, Any] = {}
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration
+                                ) -> "TransferLearningGraph":
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, vertex_name: str) -> "TransferLearningGraph":
+        """Freeze ``vertex_name`` and all its ancestors (reference
+        setFeatureExtractor: everything up to and including the named vertex
+        stops updating)."""
+        self._freeze_at = vertex_name
+        return self
+
+    def n_out_replace(self, vertex_name: str, n_out: int,
+                      weight_init: Optional[str] = None) -> "TransferLearningGraph":
+        self._replace[vertex_name] = (n_out, weight_init)
+        return self
+
+    def _ancestors(self, conf, name) -> set:
+        seen = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            if cur in seen or cur in conf.network_inputs:
+                continue
+            seen.add(cur)
+            stack.extend(conf.vertex_inputs.get(cur, []))
+        return seen
+
+    def build(self):
+        from .graph.graph import ComputationGraph
+        src = self._net
+        conf = copy.deepcopy(src.conf)
+        reinit = set()
+
+        for name, (n_out, wi) in self._replace.items():
+            v = conf.vertices[name]
+            if v.layer is None:
+                raise ValueError(f"{name!r} is not a layer vertex")
+            v.layer_conf = dataclasses.replace(
+                v.layer_conf, n_out=n_out,
+                weight_init=wi or v.layer_conf.weight_init)
+            reinit.add(name)
+            # direct LayerVertex consumers: their fan-in changed. Consumers
+            # reached THROUGH a pass-through vertex (Merge etc.) would keep a
+            # stale n_in and fail deep inside XLA later — reject loudly.
+            for cname, ins in conf.vertex_inputs.items():
+                if name in ins:
+                    cv = conf.vertices[cname]
+                    if cv.layer is not None and hasattr(cv.layer_conf, "n_in"):
+                        cv.layer_conf = dataclasses.replace(cv.layer_conf,
+                                                            n_in=n_out)
+                        reinit.add(cname)
+                    elif cv.layer is None:
+                        raise ValueError(
+                            f"n_out_replace({name!r}): consumer {cname!r} is "
+                            f"a non-layer vertex; replacing widths feeding "
+                            f"Merge/ElementWise vertices is not supported — "
+                            f"replace the consumers' layers explicitly")
+
+        if self._freeze_at is not None:
+            if self._freeze_at not in conf.vertices:
+                raise ValueError(f"Unknown vertex {self._freeze_at!r}")
+            for name in self._ancestors(conf, self._freeze_at):
+                v = conf.vertices.get(name)
+                if v is not None and v.layer is not None:
+                    v.layer_conf = dataclasses.replace(v.layer_conf, frozen=True)
+
+        if self._fine_tune is not None:
+            ft = self._fine_tune
+            if ft.updater is not None:
+                conf.updater = ft.updater
+            if ft.seed is not None:
+                conf.seed = ft.seed
+            for v in conf.vertices.values():
+                if v.layer is not None and not getattr(v.layer_conf, "frozen", False):
+                    ft.apply_to_layer(v.layer_conf)
+
+        new_net = ComputationGraph(conf).init()
+        final_params = list(new_net.params)
+        final_state = list(new_net.state)
+        for i, name in enumerate(new_net.vertex_names):
+            if name not in reinit and i < len(src.params):
+                src_idx = src.vertex_names.index(name)
+                final_params[i] = src.params[src_idx]
+                final_state[i] = src.state[src_idx]
+        new_net.params = tuple(final_params)
+        new_net.state = tuple(final_state)
+        new_net.opt_state = new_net.updater.init(new_net.params)
+        return new_net

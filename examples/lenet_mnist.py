@@ -1,0 +1,27 @@
+"""LeNet on MNIST — the canonical first example (reference
+dl4j-examples LenetMnistExample). Runs on whatever device JAX finds
+(the real TPU chip under this repo's environment)."""
+import numpy as np
+
+from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+from deeplearning4j_tpu.ui import StatsListener, render_dashboard
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+def main():
+    net = lenet(n_classes=10).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(ScoreIterationListener(50), StatsListener(storage))
+    train_it = MnistDataSetIterator(batch_size=128, train=True)
+    net.fit(iterator=train_it, epochs=1)
+    ev = net.evaluate(MnistDataSetIterator(batch_size=512, train=False))
+    print(ev.stats())
+    render_dashboard(storage, path="lenet_training.html")
+    print("dashboard written to lenet_training.html")
+
+
+if __name__ == "__main__":
+    main()

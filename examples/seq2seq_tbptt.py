@@ -1,0 +1,41 @@
+"""Seq2seq ComputationGraph (encoder LSTM -> LastTimeStep ->
+DuplicateToTimeSeries -> decoder LSTM) trained with truncated BPTT, then
+streamed step-by-step with rnn_time_step."""
+import numpy as np
+
+from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (DuplicateToTimeSeriesVertex,
+                                                  LastTimeStepVertex)
+from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def main():
+    g = (NeuralNetConfiguration(seed=5, updater=Adam(5e-3)).graph_builder()
+         .add_inputs("in")
+         .add_layer("enc", LSTM(n_out=32, activation="tanh"), "in")
+         .add_vertex("last", LastTimeStepVertex(mask_input="in"), "enc")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex(reference_input="in"), "last")
+         .add_layer("dec", LSTM(n_out=32, activation="tanh"), "dup")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dec")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(4, 20))
+         .tbptt_length(5))
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 20, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[
+        np.clip((np.cumsum(x.sum(-1), 1) > 0).astype(int), 0, 2)]
+    print("score before:", net.score(x, y))
+    net.fit(x, y, epochs=10, batch_size=32)
+    print("score after:", net.score(x, y))
+    net.rnn_clear_previous_state()
+    for t in range(3):
+        step_out = np.asarray(net.rnn_time_step(x[:2, t]))
+        print(f"streamed step {t}: {step_out.shape}")
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,41 @@ def test_multi_output_evaluate_returns_per_output_evaluations():
     xs = R.normal(size=(8, 4)).astype(np.float32)
     ys = np.eye(3, dtype=np.float32)[R.integers(0, 3, 8)]
     assert hasattr(single.evaluate(xs, ys), "accuracy")
+
+
+def test_transfer_learning_graph():
+    """Reference TransferLearning.GraphBuilder: freeze ancestors, replace the
+    output head for new classes, keep surviving weights."""
+    from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                                TransferLearningGraph)
+    src = _simple_graph()
+    x = R.normal(size=(24, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[R.integers(0, 3, 24)]
+    src.fit(x, y, epochs=5, batch_size=24)
+
+    new = (TransferLearningGraph(src)
+           .set_feature_extractor("merge")
+           .n_out_replace("out", 5, weight_init="xavier")
+           .fine_tune_configuration(FineTuneConfiguration(learning_rate=0.01))
+           .build())
+    # frozen ancestors kept their trained weights
+    for name in ("d1", "d2"):
+        si = src.vertex_names.index(name)
+        ni = new.vertex_names.index(name)
+        np.testing.assert_allclose(np.asarray(new.params[ni]["W"]),
+                                   np.asarray(src.params[si]["W"]))
+        assert new.layers[ni].frozen
+    # new head: 5 classes
+    out = np.asarray(new.output(x))
+    assert out.shape == (24, 5)
+    # training the new net leaves frozen weights untouched
+    y5 = np.eye(5, dtype=np.float32)[R.integers(0, 5, 24)]
+    before = np.asarray(new.params[new.vertex_names.index("d1")]["W"]).copy()
+    head_before = np.asarray(new.params[new.vertex_names.index("out")]["W"]).copy()
+    new.fit(x, y5, epochs=3, batch_size=24)
+    np.testing.assert_allclose(
+        np.asarray(new.params[new.vertex_names.index("d1")]["W"]), before)
+    # ...while the replaced head's weights actually moved
+    assert not np.allclose(
+        np.asarray(new.params[new.vertex_names.index("out")]["W"]),
+        head_before)

@@ -265,12 +265,17 @@ class TransferLearningGraph:
                         cv.layer_conf = dataclasses.replace(cv.layer_conf,
                                                             n_in=n_out)
                         reinit.add(cname)
-                    elif cv.layer is None:
+                    else:
+                        # Merge/ElementWise vertices, and width-dependent
+                        # layers without an n_in field (BatchNorm etc.),
+                        # would carry stale-width params into XLA — reject
+                        # loudly at build time
                         raise ValueError(
-                            f"n_out_replace({name!r}): consumer {cname!r} is "
-                            f"a non-layer vertex; replacing widths feeding "
-                            f"Merge/ElementWise vertices is not supported — "
-                            f"replace the consumers' layers explicitly")
+                            f"n_out_replace({name!r}): consumer {cname!r} "
+                            f"cannot have its fan-in adjusted automatically "
+                            f"(only layers with an n_in field are supported) "
+                            f"— restructure or replace that consumer "
+                            f"explicitly")
 
         if self._freeze_at is not None:
             if self._freeze_at not in conf.vertices:
@@ -293,11 +298,15 @@ class TransferLearningGraph:
         new_net = ComputationGraph(conf).init()
         final_params = list(new_net.params)
         final_state = list(new_net.state)
+        # REAL copies (not shared buffers): both nets' jitted train steps
+        # donate their inputs, so sharing would let training one net delete
+        # the other's arrays (same reason ComputationGraph.clone copies)
+        _copy = lambda a: jnp.array(a, copy=True)
         for i, name in enumerate(new_net.vertex_names):
             if name not in reinit and i < len(src.params):
                 src_idx = src.vertex_names.index(name)
-                final_params[i] = src.params[src_idx]
-                final_state[i] = src.state[src_idx]
+                final_params[i] = jax.tree.map(_copy, src.params[src_idx])
+                final_state[i] = jax.tree.map(_copy, src.state[src_idx])
         new_net.params = tuple(final_params)
         new_net.state = tuple(final_state)
         new_net.opt_state = new_net.updater.init(new_net.params)

@@ -26,8 +26,9 @@ def main():
     net = ComputationGraph(g.build()).init()
     rng = np.random.default_rng(0)
     x = rng.normal(size=(32, 20, 4)).astype(np.float32)
+    csum = np.cumsum(x.sum(-1), 1)
     y = np.eye(3, dtype=np.float32)[
-        np.clip((np.cumsum(x.sum(-1), 1) > 0).astype(int), 0, 2)]
+        (csum > 0).astype(int) + (csum > 3).astype(int)]   # 3 real classes
     print("score before:", net.score(x, y))
     net.fit(x, y, epochs=10, batch_size=32)
     print("score after:", net.score(x, y))

@@ -5,6 +5,7 @@ from .core import (ActivationLayer, AutoEncoder, CenterLossOutputLayer,
 from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
                    SubsamplingLayer, Subsampling1DLayer, ZeroPaddingLayer)
 from .norm import BatchNormalization, LocalResponseNormalization
+from .attention import SelfAttentionLayer
 from .recurrent import (GravesBidirectionalLSTM, GravesLSTM, LSTM,
                         LastTimeStepLayer)
 from .variational import (BernoulliReconstructionDistribution,
@@ -14,6 +15,7 @@ from .variational import (BernoulliReconstructionDistribution,
                           LossFunctionWrapper, RBM, VariationalAutoencoder)
 
 __all__ = [
+    "SelfAttentionLayer",
     "BernoulliReconstructionDistribution", "CompositeReconstructionDistribution",
     "ExponentialReconstructionDistribution", "GaussianReconstructionDistribution",
     "LossFunctionWrapper", "RBM", "VariationalAutoencoder",

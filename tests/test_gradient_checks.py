@@ -148,3 +148,68 @@ def test_loss_layer_and_masking():
     mask = np.ones(9)
     mask[5:] = 0.0
     assert check_gradients(net, x, y, labels_mask=mask, print_results=True)
+
+
+def test_conv1d_subsampling1d():
+    """Temporal conv family (reference CNN1DGradientCheckTest)."""
+    from deeplearning4j_tpu.nn.layers import (Convolution1DLayer,
+                                              RnnOutputLayer,
+                                              Subsampling1DLayer)
+    from deeplearning4j_tpu import InputType
+    net = _net([Convolution1DLayer(n_out=5, kernel_size=3,
+                                   convolution_mode="same", activation="tanh"),
+                Subsampling1DLayer(pooling_type="max", kernel_size=2, stride=1,
+                                   convolution_mode="same"),
+                RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               input_type=InputType.recurrent(3, 6))
+    x = R.normal(size=(3, 6, 3))
+    y = _onehot(R.integers(0, 2, (3, 6)).ravel(), 2).reshape(3, 6, 2)
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_embedding_layer_gradients():
+    """Embedding gather (scatter-add backward; reference GradientCheckTests
+    embedding coverage)."""
+    from deeplearning4j_tpu.nn.layers import EmbeddingLayer
+    net = _net([EmbeddingLayer(n_in=7, n_out=5, activation="tanh"),
+                DenseLayer(n_out=6, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")])
+    x = R.integers(0, 7, (10, 1))
+    y = _onehot(R.integers(0, 3, 10), 3)
+    assert check_gradients(net, x, y, print_results=True)
+
+
+def test_center_loss_output_gradients_and_dynamics():
+    """CenterLossOutputLayer: the center terms deliberately stop-gradient one
+    side each (SGD on the alpha term IS the reference's EMA center update),
+    so the full objective is not central-difference checkable — the
+    classifier path is gradchecked with the center terms off, and the center
+    DYNAMICS are asserted directly: centers move toward class feature means."""
+    from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+
+    # 1) classifier path exact (center terms disabled)
+    net = _net([DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                CenterLossOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent", alpha=0.0, lambda_=0.0)])
+    x = R.normal(size=(8, 4))
+    y = _onehot(R.integers(0, 3, 8), 3)
+    assert check_gradients(net, x, y, print_results=True)
+
+    # 2) center dynamics: with alpha on, training pulls each class's center
+    # toward that class's mean feature vector
+    import numpy as _np
+    net2 = _net([DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                 CenterLossOutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent", alpha=0.5, lambda_=0.01)])
+    x2 = R.normal(size=(60, 4))
+    yi = R.integers(0, 3, 60)
+    y2 = _onehot(yi, 3)
+    net2.fit(x2, y2, epochs=20, batch_size=60)
+    feats = _np.asarray(net2.feed_forward(x2)[1])       # dense activations
+    centers = _np.asarray(net2.params[1]["centers"])
+    for c in range(3):
+        mean_c = feats[yi == c].mean(0)
+        d_own = _np.linalg.norm(centers[c] - mean_c)
+        d_other = min(_np.linalg.norm(centers[o] - mean_c)
+                      for o in range(3) if o != c)
+        assert d_own < d_other, (c, d_own, d_other)

@@ -16,6 +16,13 @@ class RegressionEvaluation:
         self._labels: List[np.ndarray] = []
         self._preds: List[np.ndarray] = []
 
+    def merge(self, other: "RegressionEvaluation"):
+        """Accumulate another evaluation's samples (Spark eval-merge
+        capability)."""
+        self._labels.extend(other._labels)
+        self._preds.extend(other._preds)
+        return self
+
     def eval(self, labels, predictions, mask=None):
         labels = np.asarray(labels, float)
         predictions = np.asarray(predictions, float)

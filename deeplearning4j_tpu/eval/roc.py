@@ -68,6 +68,13 @@ class ROC:
         self.labels.append(labels.astype(float))
         self.scores.append(predictions.astype(float))
 
+    def merge(self, other: "ROC"):
+        """Accumulate another ROC's raw samples (the Spark-side eval-merge
+        capability, reference ROC.merge used by treeAggregate)."""
+        self.labels.extend(other.labels)
+        self.scores.extend(other.scores)
+        return self
+
     def _all(self):
         return np.concatenate(self.labels), np.concatenate(self.scores)
 
@@ -114,6 +121,18 @@ class ROCBinary:
         aucs = [r.calculate_auc() for r in self._rocs]
         return float(np.nanmean(aucs))
 
+    def merge(self, other: "ROCBinary"):
+        if other._rocs is None:
+            return self
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in other._rocs]
+        if len(self._rocs) != len(other._rocs):
+            raise ValueError(f"Cannot merge: {len(self._rocs)} vs "
+                             f"{len(other._rocs)} output columns")
+        for mine, theirs in zip(self._rocs, other._rocs):
+            mine.merge(theirs)
+        return self
+
 
 class ROCMultiClass:
     """One-vs-all ROC per class (reference ROCMultiClass.java)."""
@@ -136,3 +155,15 @@ class ROCMultiClass:
 
     def calculate_average_auc(self) -> float:
         return float(np.nanmean([r.calculate_auc() for r in self._rocs]))
+
+    def merge(self, other: "ROCMultiClass"):
+        if other._rocs is None:
+            return self
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in other._rocs]
+        if len(self._rocs) != len(other._rocs):
+            raise ValueError(f"Cannot merge: {len(self._rocs)} vs "
+                             f"{len(other._rocs)} output columns")
+        for mine, theirs in zip(self._rocs, other._rocs):
+            mine.merge(theirs)
+        return self

@@ -320,6 +320,12 @@ class ComputationGraph:
                            batch_size=batch_size, iterator=iterator, dataset=dataset)
         return self
 
+    def pretrain(self, iterator, epochs: int = 1):
+        """Layerwise unsupervised pretraining of pretrainable layer
+        vertices (reference ComputationGraph.pretrain)."""
+        self._solver().pretrain(iterator, epochs=epochs)
+        return self
+
     # ------------------------------------------------------------------ eval
     def evaluate(self, iterator_or_x, y=None):
         """Per-output classification evaluation. Single-output graphs return

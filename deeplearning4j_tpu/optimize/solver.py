@@ -205,15 +205,75 @@ class Solver:
                 iterator.reset()
         return net
 
+    def _pretrain_graph(self, iterator, epochs: int = 1):
+        """ComputationGraph layerwise pretraining (reference
+        ComputationGraph.pretrain): for each pretrainable layer vertex, its
+        INPUT vertex's activations are the data; XLA dead-code-eliminates the
+        unused downstream vertices from the traced feed computation."""
+        net = self.net
+        dtype = jnp.dtype(net.conf.dtype)
+        base_rng = jax.random.PRNGKey(net.conf.seed + 104729)
+
+        for vi, (name, layer) in enumerate(zip(net.vertex_names, net.layers)):
+            if not hasattr(layer, "pretrain_loss"):
+                continue
+            in_name = net.conf.vertex_inputs[name][0]
+            vertex = net.vertices[vi]
+
+            @jax.jit
+            def pretrain_step(layer_params, full_params, state, opt_state, it,
+                              rng, inputs, _vi=vi, _layer=layer, _in=in_name,
+                              _vertex=vertex):
+                if _in in net.conf.network_inputs:
+                    feed = inputs[net.conf.network_inputs.index(_in)]
+                else:
+                    acts, _ = net.apply_fn(full_params, state, inputs, train=False)
+                    feed = acts[_in]
+                if getattr(_vertex, "preprocessor", None) is not None:
+                    feed = _vertex.preprocessor.apply(feed)
+
+                def lf(p):
+                    return _layer.pretrain_loss(p, feed, rng)
+                loss, grads = jax.value_and_grad(lf)(layer_params)
+                rule = net.updater.rule_for(_layer)
+                new_p, new_s = {}, {}
+                for k in layer_params:
+                    upd, new_s[k] = rule.update_one(grads[k], opt_state[k],
+                                                    rule.lr(it), it)
+                    new_p[k] = layer_params[k] - upd.astype(layer_params[k].dtype)
+                return new_p, new_s, loss
+
+            rule = net.updater.rule_for(layer)
+            opt_state = {k: rule.init_one(v) for k, v in net.params[vi].items()}
+            it_count = 0
+            for _ in range(epochs):
+                for ds in iterator:
+                    feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                        else [ds.features]
+                    xs = [_cast_features(f, dtype) for f in feats]
+                    rng = jax.random.fold_in(base_rng, it_count * 1000 + vi)
+                    lp, opt_state, loss = pretrain_step(
+                        net.params[vi], net.params, net.state, opt_state,
+                        jnp.asarray(it_count, jnp.int32), rng, xs)
+                    params = list(net.params)
+                    params[vi] = lp
+                    net.params = tuple(params)
+                    it_count += 1
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        return net
+
     # -------------------------------------------------------------- pretrain
     def pretrain(self, iterator, epochs: int = 1):
         """Layerwise unsupervised pretraining (reference
-        MultiLayerNetwork.pretrain :219-299): for each pretrainable layer,
-        feed data forward through frozen earlier layers and optimize that
-        layer's reconstruction loss."""
+        MultiLayerNetwork.pretrain :219-299; ComputationGraph.pretrain): for
+        each pretrainable layer, feed data forward through frozen earlier
+        layers and optimize that layer's reconstruction loss."""
         net = self.net
         if net.params is None:
             net.init()
+        if hasattr(net, "vertex_names"):
+            return self._pretrain_graph(iterator, epochs)
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 104729)
 
@@ -283,3 +343,5 @@ def _cast_any(x, dtype):
     if isinstance(x, (list, tuple)):
         return [_cast_features(v, dtype) for v in x]
     return _cast_features(x, dtype)
+
+

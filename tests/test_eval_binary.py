@@ -105,3 +105,56 @@ def test_html_exports(tmp_path):
     cal.eval(np.stack([1 - y, y], 1), np.stack([1 - s, s], 1))
     chtml = calibration_chart_html(cal)
     assert "Reliability" in chtml and "Residual" in chtml
+
+
+def test_roc_and_regression_merge():
+    """Worker-side evals merge into the driver's (the Spark treeAggregate
+    eval-merging capability; reference ROC.merge / RegressionEvaluation.merge)."""
+    from deeplearning4j_tpu.eval import RegressionEvaluation, ROCMultiClass
+
+    y1 = (R.random(300) > 0.5).astype(float)
+    s1 = np.clip(y1 * 0.6 + R.random(300) * 0.4, 0, 1)
+    y2 = (R.random(200) > 0.5).astype(float)
+    s2 = np.clip(y2 * 0.6 + R.random(200) * 0.4, 0, 1)
+
+    a, b, both = ROC(), ROC(), ROC()
+    a.eval(np.stack([1 - y1, y1], 1), np.stack([1 - s1, s1], 1))
+    b.eval(np.stack([1 - y2, y2], 1), np.stack([1 - s2, s2], 1))
+    both.eval(np.stack([1 - np.concatenate([y1, y2]), np.concatenate([y1, y2])], 1),
+              np.stack([1 - np.concatenate([s1, s2]), np.concatenate([s1, s2])], 1))
+    a.merge(b)
+    assert abs(a.calculate_auc() - both.calculate_auc()) < 1e-12
+
+    ra, rb = ROCBinary(), ROCBinary()
+    la, pa = (R.random((50, 3)) > 0.5).astype(float), R.random((50, 3))
+    lb, pb = (R.random((70, 3)) > 0.5).astype(float), R.random((70, 3))
+    ra.eval(la, pa)
+    rb.eval(lb, pb)
+    ra.merge(rb)
+    whole = ROCBinary()
+    whole.eval(np.concatenate([la, lb]), np.concatenate([pa, pb]))
+    assert abs(ra.calculate_average_auc() - whole.calculate_average_auc()) < 1e-12
+
+    mc1, mc2 = ROCMultiClass(), ROCMultiClass()
+    lc = np.eye(3)[R.integers(0, 3, 80)]
+    pc = R.random((80, 3))
+    mc1.eval(lc[:30], pc[:30])
+    mc2.eval(lc[30:], pc[30:])
+    mc1.merge(mc2)
+    whole_mc = ROCMultiClass()
+    whole_mc.eval(lc, pc)
+    assert abs(mc1.calculate_average_auc()
+               - whole_mc.calculate_average_auc()) < 1e-12
+    # mismatched class counts refuse to merge silently
+    bad = ROCMultiClass()
+    bad.eval(np.eye(5)[R.integers(0, 5, 10)], R.random((10, 5)))
+    with pytest.raises(ValueError, match="output columns"):
+        mc1.merge(bad)
+
+    m1, m2 = RegressionEvaluation(), RegressionEvaluation()
+    m1.eval(R.normal(size=(40, 2)), R.normal(size=(40, 2)))
+    m2.eval(R.normal(size=(60, 2)), R.normal(size=(60, 2)))
+    n_before = sum(len(l) for l in m1._labels)
+    m1.merge(m2)
+    assert sum(len(l) for l in m1._labels) == n_before + 60
+    assert np.isfinite(m1.mean_squared_error(0))

@@ -175,3 +175,36 @@ def test_rbm_free_energy_surrogate_matches_cd_update():
     expected_vb = -(jnp.mean(v0, axis=0) - jnp.mean(v_model, axis=0))
     np.testing.assert_allclose(np.asarray(grads["vb"]),
                                np.asarray(expected_vb), atol=1e-10)
+
+
+def test_vae_pretrain_on_computation_graph():
+    """ComputationGraph.pretrain (reference ComputationGraph.pretrain):
+    a VAE vertex trains its ELBO against its input vertex's activations."""
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+
+    g = (NeuralNetConfiguration(seed=4, updater=Sgd(0.05), dtype="float64")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("vae", VariationalAutoencoder(
+             n_in=8, n_out=2, encoder_layer_sizes=(10,),
+             decoder_layer_sizes=(10,), activation="tanh",
+             reconstruction_distribution=BernoulliReconstructionDistribution()),
+             "in")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "vae")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(8)))
+    net = ComputationGraph(g.build()).init()
+    x = (R.random((64, 8)) > 0.6).astype(float)
+    vae_idx = net.vertex_names.index("vae")
+    layer = net.layers[vae_idx]
+    rng = jax.random.PRNGKey(0)
+    before = float(layer.pretrain_loss(net.params[vae_idx], jnp.asarray(x), rng))
+    it = ListDataSetIterator(features=x, labels=x, batch_size=16)
+    net.pretrain(it, epochs=25)
+    after = float(layer.pretrain_loss(net.params[vae_idx], jnp.asarray(x), rng))
+    assert after < before
+    # supervised fine-tuning on top still works
+    y = np.eye(2)[R.integers(0, 2, 64)]
+    net.fit(x, y, epochs=2, batch_size=64)

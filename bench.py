@@ -7,19 +7,30 @@ device/batch/dtype — target >= 0.70x (vs_baseline = ours/reference).
 
 The same line carries an ``extras`` dict with the remaining BASELINE rows:
   - resnet50_bf16_img_per_sec      ResNet-50, bfloat16 params+data, batch>=128
+  - resnet50_bf16_flax_img_per_sec independent flax ResNet-50, same bf16/batch
+  - resnet50_bf16_vs_flax_bf16     apples-to-apples bf16 ratio (ours/flax)
+  - mfu                            achieved TFLOP/s + MFU for ResNet f32/bf16
+                                   and the LSTM, from XLA's compiled-program
+                                   cost analysis over measured step time,
+                                   against the chip's bf16 peak (v5e: 197
+                                   TFLOP/s; override BENCH_PEAK_TFLOPS)
   - lstm_train_tokens_per_sec      GravesLSTM char-RNN (BASELINE #3)
   - lstm_plain_tokens_per_sec      plain (no-peephole) LSTM, same shapes
   - lstm_reference_tokens_per_sec  independent flax OptimizedLSTMCell char-RNN
   - lstm_vs_reference              plain / reference (apples-to-apples ratio)
-  - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE #4)
-  - dp_scaling_efficiency_8dev     ParallelWrapper on the 8-device virtual CPU
-                                   mesh (BASELINE #5; chips unavailable, so
-                                   this reports mesh-overhead efficiency, not
-                                   ICI bandwidth)
+  - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
+                                   #4), gated on a measured loss decrease on a
+                                   held probe batch (quality gate)
+  - collective_overhead_by_mesh    per-step overhead of psum sync-DP on 1/2/
+                                   4/8-device virtual CPU meshes (BASELINE #5;
+                                   chips unavailable, so this measures mesh +
+                                   collective dispatch overhead, not ICI)
   - threshold_encode_ms_25m        threshold encode+decode on a 25M-param
                                    flat gradient (DCN codec overhead)
 
-Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1.
+Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
+BENCH_BUDGET_S, BENCH_PEAK_TFLOPS, BENCH_REPEATS (timed windows per bench,
+best-of; default 3).
 """
 import functools
 import json
@@ -36,18 +47,51 @@ STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 WARMUP = 3
 
 
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+
 def _time_steps(step_fn, args, steps):
-    """args: list of donated-loop state; step_fn returns new state tuple."""
+    """args: list of donated-loop state; step_fn returns new state tuple.
+    Best-of-REPEATS timed windows: the axon chip is reached through a
+    tunnel and a single ~1s window shows run-to-run swings of +-15%, so
+    the minimum over a few windows is the honest steady-state number."""
+    import jax
     state = args
     for _ in range(WARMUP):
         state = step_fn(*state)
-    import jax
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state = step_fn(*state)
-    jax.block_until_ready(state)
-    return (time.perf_counter() - t0) / steps
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step_fn(*state)
+        jax.block_until_ready(state)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+# v5e bf16 MXU peak. f32 matmuls/convs at JAX's DEFAULT precision also run
+# as single bf16 MXU passes on TPU, so the same peak is the honest
+# denominator for both dtypes here.
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197.0"))
+
+
+def _aot(jitted, args):
+    """AOT-compile a jitted step once and pull XLA's flop estimate for the
+    whole training step from the compiled executable's cost analysis.
+    Returns (callable, flops_per_step_or_None). Timing the AOT executable
+    avoids a second trace/compile through jit's own cache."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops") if hasattr(ca, "get") else None
+        return compiled, (float(flops) if flops else None)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"AOT cost analysis unavailable ({e}); timing via jit",
+              file=sys.stderr)
+        return jitted, None
 
 
 def bench_ours(dtype="float32", batch=None, img=None):
@@ -73,18 +117,24 @@ def bench_ours(dtype="float32", batch=None, img=None):
         new_params, new_opt = net.updater.update(grads, opt_state, params, it)
         return new_params, new_state, new_opt, it + 1, key
 
-    dt = _time_steps(step, [net.params, net.state, net.opt_state,
-                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
-                     STEPS)
-    return batch / dt
+    args = [net.params, net.state, net.opt_state,
+            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)]
+    runner, flops = _aot(step, args)
+    dt = _time_steps(runner, args, STEPS)
+    return batch / dt, flops
 
 
-def bench_reference():
-    """Independent flax.linen ResNet-50 + optax SGD-momentum."""
+def bench_reference(dtype="float32", batch=None):
+    """Independent flax.linen ResNet-50 + optax SGD-momentum. ``dtype``
+    applies to params AND data (param_dtype + compute dtype), matching
+    bench_ours' all-bf16 configuration for the apples-to-apples ratio."""
     import jax
     import jax.numpy as jnp
     import flax.linen as nn
     import optax
+
+    batch = batch or BATCH
+    jdt = jnp.dtype(dtype)
 
     class Bottleneck(nn.Module):
         filters: int
@@ -93,27 +143,31 @@ def bench_reference():
 
         @nn.compact
         def __call__(self, x, train):
+            kw = dict(use_bias=False, dtype=jdt, param_dtype=jdt)
+            bn = dict(use_running_average=not train, dtype=jdt, param_dtype=jdt)
             r = x
             y = nn.Conv(self.filters, (1, 1), (self.stride, self.stride),
-                        use_bias=False)(x)
-            y = nn.BatchNorm(use_running_average=not train)(y)
+                        **kw)(x)
+            y = nn.BatchNorm(**bn)(y)
             y = nn.relu(y)
-            y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
-            y = nn.BatchNorm(use_running_average=not train)(y)
+            y = nn.Conv(self.filters, (3, 3), **kw)(y)
+            y = nn.BatchNorm(**bn)(y)
             y = nn.relu(y)
-            y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
-            y = nn.BatchNorm(use_running_average=not train)(y)
+            y = nn.Conv(self.filters * 4, (1, 1), **kw)(y)
+            y = nn.BatchNorm(**bn)(y)
             if self.project:
                 r = nn.Conv(self.filters * 4, (1, 1),
-                            (self.stride, self.stride), use_bias=False)(x)
-                r = nn.BatchNorm(use_running_average=not train)(r)
+                            (self.stride, self.stride), **kw)(x)
+                r = nn.BatchNorm(**bn)(r)
             return nn.relu(y + r)
 
     class ResNet50(nn.Module):
         @nn.compact
         def __call__(self, x, train=True):
-            x = nn.Conv(64, (7, 7), (2, 2), use_bias=False)(x)
-            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.Conv(64, (7, 7), (2, 2), use_bias=False, dtype=jdt,
+                        param_dtype=jdt)(x)
+            x = nn.BatchNorm(use_running_average=not train, dtype=jdt,
+                             param_dtype=jdt)(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
             for i, (f, blocks, s) in enumerate([(64, 3, 1), (128, 4, 2),
@@ -122,12 +176,12 @@ def bench_reference():
                 for _ in range(blocks - 1):
                     x = Bottleneck(f)(x, train)
             x = jnp.mean(x, axis=(1, 2))
-            return nn.Dense(1000)(x)
+            return nn.Dense(1000, dtype=jdt, param_dtype=jdt)(x)
 
     model = ResNet50()
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(BATCH, IMG, IMG, 3)), jnp.float32)
-    labels = jnp.asarray(rng.integers(0, 1000, BATCH))
+    x = jnp.asarray(rng.normal(size=(batch, IMG, IMG, 3)), jdt)
+    labels = jnp.asarray(rng.integers(0, 1000, batch))
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
@@ -145,8 +199,10 @@ def bench_reference():
         updates, new_opt = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_bs, new_opt
 
-    dt = _time_steps(step, [params, batch_stats, opt_state], STEPS)
-    return BATCH / dt
+    args = [params, batch_stats, opt_state]
+    runner, flops = _aot(step, args)
+    dt = _time_steps(runner, args, STEPS)
+    return batch / dt, flops
 
 
 def bench_lstm(cell: str = "graves"):
@@ -181,10 +237,11 @@ def bench_lstm(cell: str = "graves"):
         new_params, new_opt = net.updater.update(grads, opt_state, params, it)
         return new_params, new_state, new_opt, it + 1, key
 
-    dt = _time_steps(step, [net.params, net.state, net.opt_state,
-                            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)],
-                     STEPS)
-    return B * T / dt
+    args = [net.params, net.state, net.opt_state,
+            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)]
+    runner, flops = _aot(step, args)
+    dt = _time_steps(runner, args, STEPS)
+    return B * T / dt, flops
 
 
 def bench_lstm_reference():
@@ -230,10 +287,14 @@ def bench_lstm_reference():
 
 def bench_word2vec():
     """SkipGram negative-sampling jitted step, words(centers)/sec
-    (BASELINE #4: large embedding table)."""
+    (BASELINE #4: large embedding table). The throughput number is tied to
+    a quality gate: after the timed steps the SGNS probe loss on the
+    training pairs (fresh negatives) must have decreased, so a silent
+    correctness regression can't hide behind a fast step."""
     import jax
     import jax.numpy as jnp
-    from deeplearning4j_tpu.nlp.sequence_vectors import make_neg_sampling_step
+    from deeplearning4j_tpu.nlp.sequence_vectors import (_sgns_grads,
+                                                         make_neg_sampling_step)
 
     V, D, B, NEG = 100_000, 128, 4096, 5
     rng = np.random.default_rng(0)
@@ -244,13 +305,35 @@ def bench_word2vec():
     contexts = jnp.asarray(rng.integers(0, V, (B,)))
     key = jax.random.PRNGKey(0)
 
+    @jax.jit
+    def probe_loss(syn0, syn1):
+        negs = jax.random.randint(jax.random.PRNGKey(123), (B, NEG), 0, V)
+        *_, loss_row = _sgns_grads(syn0[centers], syn1[contexts], syn1[negs])
+        return jnp.sum(loss_row) / B
+
+    loss_before = float(probe_loss(syn0, syn1))
+
     def wrapped(syn0, syn1, key):
         k1, k2 = jax.random.split(key)
         s0, s1 = step(syn0, syn1, centers, contexts, k1)
         return s0, s1, k2
 
     dt = _time_steps(wrapped, [syn0, syn1, key], STEPS)
-    return B / dt
+
+    # the quality gate: a few more optimizer steps from scratch must
+    # strictly reduce the probe loss
+    s0 = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32) * 0.01)
+    s1, k = jnp.zeros((V, D), jnp.float32), jax.random.PRNGKey(7)
+    for _ in range(10):
+        s0, s1, k = wrapped(s0, s1, k)
+    loss_after = float(probe_loss(s0, s1))
+    if not loss_after < loss_before:
+        raise RuntimeError(
+            f"word2vec quality gate FAILED: probe loss {loss_before:.4f} -> "
+            f"{loss_after:.4f} did not decrease")
+    return {"words_per_sec": round(B / dt, 3),
+            "probe_loss_before": round(loss_before, 4),
+            "probe_loss_after": round(loss_after, 4), "gate": "ok"}
 
 
 def bench_threshold_encode():
@@ -274,49 +357,52 @@ def bench_threshold_encode():
     return dt * 1e3
 
 
-def bench_dp_scaling():
-    """ParallelWrapper scaling efficiency on the 8-device VIRTUAL CPU mesh
-    (BASELINE #5 — real chips unavailable; measures mesh overhead only).
-    Runs in a subprocess so the CPU platform doesn't poison this process."""
+def bench_collective_overhead():
+    """Collective-overhead breakdown per mesh shape on VIRTUAL CPU devices
+    (BASELINE #5 — real chips unavailable, so chip-scaling efficiency is
+    unmeasurable here; what IS measurable is the framework's added cost per
+    mesh shape: the per-step delta between a sharded train-style step WITH
+    the psum gradient sync and the identical step without it, at a FIXED
+    per-device shard of 25M/8 elements — weak scaling, so the global
+    gradient is ndev*25M/8 and reaches ResNet-50 size (25M) on the 8-device
+    mesh). Runs in a subprocess so the CPU platform doesn't poison this
+    process."""
     code = r"""
-import json, os, time, functools
+import json, time, functools
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
-from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
-from deeplearning4j_tpu.optimize.updaters import Sgd
-from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from jax.sharding import PartitionSpec as P
 from deeplearning4j_tpu.parallel.mesh import make_mesh
-from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
 
-def run(workers, batch):
-    conf = (NeuralNetConfiguration(seed=1, updater=Sgd(0.1), dtype="float32")
-            .list(DenseLayer(n_in=256, n_out=512, activation="relu"),
-                  DenseLayer(n_out=512, activation="relu"),
-                  OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
-            .build())
-    net = MultiLayerNetwork(conf).init()
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch * 8, 256)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch * 8)]
-    it = ListDataSetIterator(features=x, labels=y, batch_size=batch * workers)
-    pw = ParallelWrapper(net, workers=workers)
-    pw.fit(it, epochs=1)     # compile + warm
-    it.reset()
-    t0 = time.perf_counter()
-    pw.fit(it, epochs=2)
-    dt = time.perf_counter() - t0
-    n_ex = 2 * batch * 8
-    return n_ex / dt
+N = 25_000_000          # ResNet-50-sized flat gradient
+out = {}
+for ndev in (1, 2, 4, 8):
+    mesh = make_mesh((ndev,), ("data",), devices=jax.devices()[:ndev])
+    g = jnp.ones((ndev, N // 8), jnp.float32)  # fixed per-device shard size
 
-one = run(1, 128)
-eight = run(8, 128)
-print(json.dumps({"x1": one, "x8": eight, "eff": eight / (8 * one),
-                  "note": "8 VIRTUAL devices share one physical CPU core: "
-                          "this measures mesh/collective overhead, not chip "
-                          "scaling (no multi-chip hardware available)"}))
+    with_sync = jax.jit(jax.shard_map(
+        lambda g: jax.lax.psum(g * 0.5, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P("data")))
+    without_sync = jax.jit(jax.shard_map(
+        lambda g: g * 0.5, mesh=mesh,
+        in_specs=P("data"), out_specs=P("data")))
+
+    def t(f):
+        r = f(g); jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            r = f(g)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / 10 * 1e3
+    a, b = t(with_sync), t(without_sync)
+    out[str(ndev)] = {"step_ms": round(a, 3), "nosync_ms": round(b, 3),
+                      "collective_ms": round(a - b, 3)}
+out["note"] = ("virtual CPU devices on one physical core: measures the "
+               "framework's psum dispatch/copy overhead per mesh shape, "
+               "not ICI bandwidth (no multi-chip hardware available)")
+print(json.dumps(out))
 """
     env = dict(os.environ)
     # env must be set BEFORE the interpreter starts (sitecustomize pre-imports
@@ -329,7 +415,7 @@ print(json.dumps({"x1": one, "x8": eight, "eff": eight / (8 * one),
                          cwd=os.path.dirname(os.path.abspath(__file__)))
     lines = out.stdout.strip().splitlines()
     if out.returncode != 0 or not lines:
-        raise RuntimeError(f"dp-scaling subprocess failed (rc={out.returncode}): "
+        raise RuntimeError(f"collective-overhead subprocess failed (rc={out.returncode}): "
                            f"{out.stderr.strip()[-500:]}")
     return json.loads(lines[-1])
 
@@ -348,54 +434,103 @@ def _global_warmup(seconds: float = 5.0):
     jax.block_until_ready(a)
 
 
+def _mfu(rate_per_sec, per_what, flops_per_step, batch_like):
+    """Achieved TFLOP/s + MFU from XLA's per-step flop estimate and the
+    measured rate. rate is items/sec; batch_like items per step."""
+    if not flops_per_step:
+        return None
+    steps_per_sec = rate_per_sec / batch_like
+    achieved = flops_per_step * steps_per_sec / 1e12
+    return {"achieved_tflops": round(achieved, 2),
+            "mfu": round(achieved / PEAK_TFLOPS, 4),
+            "flops_per_step": flops_per_step, "per": per_what}
+
+
+def _stage(name, t0):
+    print(f"[bench] {name}: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr, flush=True)
+
+
 def main():
+    t0 = time.perf_counter()
     _global_warmup()
-    ours = bench_ours()
+    _stage("warmup", t0)
+    mfu = {}
+    t0 = time.perf_counter()
+    ours, fl = bench_ours()
+    _stage("resnet50_f32_ours", t0)
+    mfu["resnet50_f32"] = _mfu(ours, "step(batch=%d)" % BATCH, fl, BATCH)
+    t0 = time.perf_counter()
     try:
-        ref = bench_reference()
+        ref, _ = bench_reference()
     except Exception as e:
         print(f"reference bench failed: {e}", file=sys.stderr)
         ref = None
+    _stage("resnet50_f32_flax", t0)
     ratio = (ours / ref) if ref else None
+
+    bf16_batch = BATCH if "BENCH_BATCH" in os.environ else 128
+
+    def _bf16_ours():
+        # bf16 halves activation memory, so a larger batch fits and feeds
+        # the MXU better. An explicit BENCH_BATCH is honored (memory bound).
+        r, f = bench_ours(dtype="bfloat16", batch=bf16_batch)
+        mfu["resnet50_bf16"] = _mfu(r, f"step(batch={bf16_batch})", f,
+                                    bf16_batch)
+        return r
+
+    def _bf16_flax():
+        r, _ = bench_reference(dtype="bfloat16", batch=bf16_batch)
+        return r
+
+    def _lstm(cell="graves"):
+        r, f = bench_lstm(cell)
+        if cell == "plain":
+            mfu["lstm_plain"] = _mfu(r, "step(B=32,T=64)", f, 32 * 64)
+        return r
 
     extras = {}
     # hard wall-clock budget: the driver must ALWAYS get the JSON line, so
     # extras are skipped (reported null) once the budget is spent
-    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "480"))
     t_start = time.perf_counter()
     if os.environ.get("BENCH_SKIP_EXTRAS", "0") != "1":
         for name, fn in [
-            # bf16 halves activation memory, so a larger batch fits and
-            # feeds the MXU better (~+20% over batch 64). An explicit
-            # BENCH_BATCH is honored (it exists to bound memory).
-            ("resnet50_bf16_img_per_sec",
-             lambda: bench_ours(dtype="bfloat16",
-                                batch=BATCH if "BENCH_BATCH" in os.environ
-                                else 128)),
-            ("lstm_train_tokens_per_sec", bench_lstm),
-            ("lstm_plain_tokens_per_sec", lambda: bench_lstm(cell="plain")),
+            ("resnet50_bf16_img_per_sec", _bf16_ours),
+            ("resnet50_bf16_flax_img_per_sec", _bf16_flax),
+            ("lstm_train_tokens_per_sec", _lstm),
+            ("lstm_plain_tokens_per_sec", lambda: _lstm("plain")),
             ("lstm_reference_tokens_per_sec", bench_lstm_reference),
             ("word2vec_words_per_sec", bench_word2vec),
             ("threshold_encode_ms_25m", bench_threshold_encode),
-            ("dp_scaling_efficiency_8dev", bench_dp_scaling),
+            ("collective_overhead_by_mesh", bench_collective_overhead),
         ]:
             if time.perf_counter() - t_start > budget:
                 print(f"extra bench {name} skipped: budget exhausted",
                       file=sys.stderr)
                 extras[name] = None
                 continue
+            t0 = time.perf_counter()
             try:
                 v = fn()
                 extras[name] = round(v, 3) if isinstance(v, float) else v
             except Exception as e:
                 print(f"extra bench {name} failed: {e}", file=sys.stderr)
                 extras[name] = None
+            _stage(name, t0)
         if extras.get("lstm_plain_tokens_per_sec") and \
                 extras.get("lstm_reference_tokens_per_sec"):
             # plain-vs-plain: both sides are standard (no-peephole) LSTMs
             extras["lstm_vs_reference"] = round(
                 extras["lstm_plain_tokens_per_sec"]
                 / extras["lstm_reference_tokens_per_sec"], 3)
+        if extras.get("resnet50_bf16_img_per_sec") and \
+                extras.get("resnet50_bf16_flax_img_per_sec"):
+            extras["resnet50_bf16_vs_flax_bf16"] = round(
+                extras["resnet50_bf16_img_per_sec"]
+                / extras["resnet50_bf16_flax_img_per_sec"], 3)
+    # the headline f32 MFU is computed regardless of BENCH_SKIP_EXTRAS
+    extras["mfu"] = {k: v for k, v in mfu.items() if v} or None
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",

@@ -54,7 +54,11 @@ class NearestNeighborsServer:
                         i = int(req["index"])
                         if not (0 <= i < len(server.points)):
                             raise IndexError(f"index {i} out of range")
-                        # query by the stored point; drop the self-match
+                        # query by the stored point; drop the self-match.
+                        # k clamps to n-1 (there are only n-1 other points);
+                        # a k+1 query then always yields >= k non-self pairs
+                        # (VPTree.knn returns exactly k+1 unique indices)
+                        k = min(k, len(server.points) - 1)
                         idxs, dists = server.tree.knn(server.points[i], k + 1)
                         pairs = [(j, d) for j, d in zip(idxs, dists)
                                  if j != i][:k]

@@ -39,10 +39,21 @@ class EvaluationBinary:
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:     # time series: flatten [B,T,L] -> [B*T,L]
-            labels = labels.reshape(-1, labels.shape[-1])
-            predictions = predictions.reshape(-1, predictions.shape[-1])
+            B, T, L = labels.shape
+            labels = labels.reshape(-1, L)
+            predictions = predictions.reshape(-1, L)
             if mask is not None:
-                mask = np.asarray(mask).reshape(-1)[:, None]
+                mask = np.asarray(mask)
+                if mask.shape[:2] != (B, T) or mask.ndim not in (2, 3) or \
+                        (mask.ndim == 3 and mask.shape[-1] not in (1, L)):
+                    raise ValueError(
+                        f"time-series mask must be [B,T]={B, T}, [B,T,1] or "
+                        f"[B,T,{L}]; got shape {mask.shape}")
+                if mask.ndim == 2 or mask.shape[-1] == 1:
+                    # [B,T] or [B,T,1]: one flag per time step
+                    mask = mask.reshape(-1)[:, None]
+                else:
+                    mask = mask.reshape(-1, L)   # [B,T,L] per-label mask
         self._ensure(labels.shape[-1])
         thr = 0.5 if self.threshold is None else np.asarray(self.threshold)
         pred = (predictions > thr).astype(np.int8)

@@ -56,9 +56,13 @@ class DistributedWord2Vec(SequenceVectors):
             # point of the reference's sparse update shipping) and every
             # device scatter-adds the full set, keeping tables replicated.
             D = syn0.shape[1]
-            grad_v, g_upos, g_uneg, _ = _sgns_grads(
+            grad_v, g_upos, g_uneg, loss_row = _sgns_grads(
                 syn0[centers], syn1[contexts], syn1[negs])
             w = valid[:, None]               # padded rows contribute nothing
+            # masked per-row loss; psum over shards -> every device returns
+            # the global pair-loss sum (same formula as the single-device
+            # step by construction: one _sgns_grads definition)
+            loss = jax.lax.psum(jnp.sum(loss_row * valid), "data")
             ac = jax.lax.all_gather(centers, "data", tiled=True)
             agv = jax.lax.all_gather(-lr * grad_v * w, "data", tiled=True)
             act = jax.lax.all_gather(contexts, "data", tiled=True)
@@ -70,12 +74,12 @@ class DistributedWord2Vec(SequenceVectors):
             syn0 = syn0.at[ac].add(agv)
             syn1 = syn1.at[act].add(agp)
             syn1 = syn1.at[an].add(agn)
-            return syn0, syn1
+            return syn0, syn1, loss
 
         rep, dsh = P(), P("data")
         fn = shard_map(worker, mesh=mesh,
                        in_specs=(rep, rep, dsh, dsh, dsh, rep, dsh),
-                       out_specs=(rep, rep), check_vma=False)
+                       out_specs=(rep, rep, rep), check_vma=False)
         jfn = jax.jit(fn, donate_argnums=(0, 1))
 
         def step(syn0, syn1, centers, contexts, negs, lr, ctx_mask=None):
@@ -87,8 +91,8 @@ class DistributedWord2Vec(SequenceVectors):
                 negs = jnp.concatenate(
                     [negs, jnp.zeros((pad, negs.shape[1]), negs.dtype)])
             valid = (jnp.arange(B + pad) < B).astype(syn0.dtype)
-            syn0, syn1 = jfn(syn0, syn1, centers, contexts, negs,
-                             jnp.asarray(lr, syn0.dtype), valid)
-            return syn0, syn1, jnp.asarray(0.0)
+            syn0, syn1, loss = jfn(syn0, syn1, centers, contexts, negs,
+                                   jnp.asarray(lr, syn0.dtype), valid)
+            return syn0, syn1, loss / B    # mean pair loss, like single-device
 
         return step

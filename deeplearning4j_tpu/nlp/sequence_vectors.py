@@ -29,12 +29,14 @@ from .vocab import VocabCache
 def _sgns_grads(v, u_pos, u_neg):
     """Analytic skip-gram-negative-sampling gradients for the GATHERED rows.
 
-    loss = softplus(-v.u_pos) + sum_k softplus(v.u_neg_k), summed over the
-    batch. Returns (grad_v, grad_u_pos, grad_u_neg, loss_sum). Identical to
+    loss_row = softplus(-v.u_pos) + sum_k softplus(v.u_neg_k), per batch row.
+    Returns (grad_v, grad_u_pos, grad_u_neg, loss_row[B]). Identical to
     what jax.grad of the dense loss produces — but expressed on the [B,D]/
     [B,k,D] gathered rows so the update is a pure scatter-add; no dense [V,D]
     gradient is ever materialized (the reference's native AggregateSkipGram
-    avoids exactly this; VERDICT r1 weak #7).
+    avoids exactly this; VERDICT r1 weak #7). The per-row form is the single
+    source of the loss definition: callers sum (optionally masked) so the
+    single-device and distributed steps can never report diverging losses.
     """
     import jax
     import jax.numpy as jnp
@@ -45,9 +47,9 @@ def _sgns_grads(v, u_pos, u_neg):
     grad_v = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
     grad_u_pos = g_pos[:, None] * v
     grad_u_neg = g_neg[..., None] * v[:, None, :]
-    loss = jnp.sum(jax.nn.softplus(-pos_logit)) + \
-        jnp.sum(jax.nn.softplus(neg_logit))
-    return grad_v, grad_u_pos, grad_u_neg, loss
+    loss_row = jax.nn.softplus(-pos_logit) + \
+        jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+    return grad_v, grad_u_pos, grad_u_neg, loss_row
 
 
 def make_neg_sampling_step(lr: float, negative: int):
@@ -119,8 +121,9 @@ class SequenceVectors:
                 v = (syn0[centers] * ctx_mask[..., None]).sum(1) / denom
             else:
                 v = syn0[centers]          # [B, D]
-            grad_v, g_upos, g_uneg, loss = _sgns_grads(v, syn1[contexts],
-                                                       syn1[negs])
+            grad_v, g_upos, g_uneg, loss_row = _sgns_grads(v, syn1[contexts],
+                                                           syn1[negs])
+            loss = jnp.sum(loss_row)
             syn1 = syn1.at[contexts].add(-lr * g_upos)
             syn1 = syn1.at[negs.reshape(-1)].add(-lr * g_uneg.reshape(-1, D))
             if cbow:

@@ -50,8 +50,11 @@ class FineTuneConfiguration:
             conf.updater = self.updater
         if self.seed is not None:
             conf.seed = self.seed
+        # skip frozen layers, matching TransferLearningGraph.build — frozen
+        # pretrained weights keep their original regularization/dropout
         for layer in conf.layers:
-            self.apply_to_layer(layer)
+            if not getattr(layer, "frozen", False):
+                self.apply_to_layer(layer)
 
 
 class TransferLearning:

@@ -144,10 +144,15 @@ class SecondOrderOptimizer:
         if step > 0:
             new_flat = flat + step * d
             self._record(flat, g, new_flat, step)
+            # new_state (BatchNorm running stats etc.) comes from the single
+            # pre-step forward pass — same convention as the reference, which
+            # evaluates score/gradient once per outer iteration at the
+            # incoming parameters (BaseOptimizer.optimize).
             self.problem.commit(new_flat, new_state)
             return fx
         self._record(flat, g, flat, 0.0)
-        self.problem.commit(flat, new_state)
+        # line search rejected every step length: a zero-length step must be
+        # a true no-op, so do NOT advance normalization state either
         return f0
 
     def _record(self, flat, g, new_flat, step):

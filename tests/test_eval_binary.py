@@ -158,3 +158,54 @@ def test_roc_and_regression_merge():
     m1.merge(m2)
     assert sum(len(l) for l in m1._labels) == n_before + 60
     assert np.isfinite(m1.mean_squared_error(0))
+
+
+def test_binary_eval_per_label_timeseries_mask():
+    """A [B,T,L] per-label mask masks each label column independently
+    (reference EvaluationBinary supports per-output masking; advisor r2)."""
+    from deeplearning4j_tpu.eval.binary import EvaluationBinary
+    B, T, L = 4, 6, 3
+    labels = (R.random((B, T, L)) > 0.5).astype(np.float32)
+    preds = R.random((B, T, L)).astype(np.float32)
+    mask = (R.random((B, T, L)) > 0.3).astype(np.float32)
+
+    e3 = EvaluationBinary()
+    e3.eval(labels, preds, mask=mask)
+    # equivalent flat evaluation with the same per-element mask
+    ef = EvaluationBinary()
+    ef.eval(labels.reshape(-1, L), preds.reshape(-1, L),
+            mask=mask.reshape(-1, L))
+    np.testing.assert_array_equal(e3.tp, ef.tp)
+    np.testing.assert_array_equal(e3.fn, ef.fn)
+    # total counted = number of unmasked elements per label
+    totals = [e3.total_count(i) for i in range(L)]
+    np.testing.assert_array_equal(totals, mask.reshape(-1, L).sum(0))
+    # a bogus mask rank is rejected with a clear error
+    import pytest
+    with pytest.raises(ValueError, match="mask must be"):
+        EvaluationBinary().eval(labels, preds, mask=np.ones((B,)))
+
+
+def test_fine_tune_skips_frozen_layers_mln():
+    """FineTuneConfiguration overrides must not touch frozen layers — same
+    behavior as the CG transfer path (advisor r2)."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                                TransferLearning)
+    from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+    conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=8, n_out=8, activation="relu", l2=0.25),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    new = (TransferLearning(net)
+           .set_feature_extractor(0)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-3),
+                                                          l2=0.01))
+           .build())
+    assert new.conf.layers[0].frozen
+    assert new.conf.layers[0].l2 == 0.25        # frozen: untouched
+    assert new.conf.layers[1].l2 == 0.01        # unfrozen: overridden

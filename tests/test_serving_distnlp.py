@@ -35,10 +35,17 @@ def test_distributed_w2v_step_matches_single_device():
                            centers, contexts, negs, 0.05)
 
     dist = DistributedWord2Vec(layer_size=D, negative=k)._build_step()
-    s0_b, s1_b, _ = dist(jnp.asarray(syn0_np), jnp.asarray(syn1_np),
-                         centers, contexts, negs, 0.05)
+    s0_b, s1_b, dist_loss = dist(jnp.asarray(syn0_np), jnp.asarray(syn1_np),
+                                 centers, contexts, negs, 0.05)
     np.testing.assert_allclose(np.asarray(s0_b), np.asarray(s0_a), atol=2e-6)
     np.testing.assert_allclose(np.asarray(s1_b), np.asarray(s1_a), atol=2e-6)
+    # the distributed step reports the real mean pair loss, matching the
+    # single-device step's (advisor r2: it used to return a constant 0.0)
+    single2 = SequenceVectors(layer_size=D, negative=k)._build_step()
+    _, _, single_loss = single2(jnp.asarray(syn0_np), jnp.asarray(syn1_np),
+                                centers, contexts, negs, 0.05)
+    assert float(dist_loss) > 0.0
+    np.testing.assert_allclose(float(dist_loss), float(single_loss), rtol=1e-5)
 
 
 def test_distributed_w2v_end_to_end_similarity():

@@ -1,11 +1,14 @@
 """DeepWalk graph embeddings.
 
 Reference: deeplearning4j-graph graph/models/deepwalk/DeepWalk.java:31 —
-random walks fed to a skip-gram trainer (the reference uses hierarchical
-softmax over a GraphHuffman tree + InMemoryGraphLookupTable; here the walks
-ride the SequenceVectors engine's batched negative-sampling step, the same
-substitution the engine documents for Word2Vec — HS's tree walk is hostile
-to the MXU, similarity behavior is validated instead of bitwise parity).
+random walks fed to a skip-gram trainer with hierarchical softmax over a
+GraphHuffman tree (InMemoryGraphLookupTable). Here the walks ride the
+SequenceVectors engine; the default objective is the reference's
+hierarchical softmax, batched over padded Huffman paths (the tree is coded
+by vertex occurrence frequency in the walks — proportional to the stationary
+visit distribution, where the reference's GraphHuffman codes by degree; same
+objective family, similarity behavior validated instead of bitwise parity).
+``use_hierarchical_softmax=False`` selects batched negative sampling instead.
 """
 from __future__ import annotations
 
@@ -25,7 +28,8 @@ class DeepWalk:
     def __init__(self, *, vector_size: int = 100, window_size: int = 5,
                  walk_length: int = 40, walks_per_vertex: int = 1,
                  learning_rate: float = 0.025, negative: int = 5,
-                 epochs: int = 1, seed: int = 123):
+                 epochs: int = 1, seed: int = 123,
+                 use_hierarchical_softmax: bool = True):
         self.vector_size = vector_size
         self.window_size = window_size
         self.walk_length = walk_length
@@ -34,6 +38,7 @@ class DeepWalk:
         self.negative = negative
         self.epochs = epochs
         self.seed = seed
+        self.use_hierarchical_softmax = use_hierarchical_softmax
         self._sv: Optional[SequenceVectors] = None
         self._n_vertices = 0
 
@@ -57,7 +62,8 @@ class DeepWalk:
             layer_size=self.vector_size, window=self.window_size,
             min_word_frequency=1, negative=self.negative,
             learning_rate=self.learning_rate, epochs=self.epochs,
-            seed=self.seed)
+            seed=self.seed,
+            use_hierarchical_softmax=self.use_hierarchical_softmax)
         self._sv.fit(token_seqs)
         return self
 

@@ -6,11 +6,13 @@ per-layer translators layers/Keras* (name registry KerasLayer.java:48-70),
 Hdf5Archive.java:22-35 (native HDF5 read — h5py here plays the role of the
 JavaCPP hdf5 binding; SURVEY.md §2.6.3).
 
-Supports the Keras-1.x-era surface the reference covers: Sequential and
-functional Model configs with Dense, Conv2D(Convolution2D), MaxPooling2D,
-AveragePooling2D, Flatten, Dropout, Activation, BatchNormalization, LSTM,
-Embedding, ZeroPadding2D, Merge/Add/Concatenate, GlobalAveragePooling2D,
-GlobalMaxPooling2D. Both 'th' (channels-first) and 'tf' dim orderings; our
+Supports the Keras-1.x-era surface the reference covers (the full
+KerasLayer.java:53-70 registry): Sequential and functional Model configs with
+Dense, Conv2D(Convolution2D), Conv1D(Convolution1D), MaxPooling1D/2D,
+AveragePooling1D/2D, Flatten, Dropout, Activation, BatchNormalization, LSTM,
+Embedding, ZeroPadding1D/2D, Merge/Add/Concatenate, GlobalAveragePooling1D/2D,
+GlobalMaxPooling1D/2D, TimeDistributed(Dense).
+Both 'th' (channels-first) and 'tf' dim orderings; our
 runtime layout is NHWC, so 'th' kernels are transposed at import
 (the analogue of the reference's TensorFlowCnnToFeedForwardPreProcessor).
 """
@@ -23,10 +25,12 @@ import numpy as np
 
 from ..nn.conf.config import NeuralNetConfiguration
 from ..nn.inputs import InputType
-from ..nn.layers import (ActivationLayer, BatchNormalization, ConvolutionLayer,
-                         DenseLayer, DropoutLayer, EmbeddingLayer,
-                         GlobalPoolingLayer, LSTM, OutputLayer,
-                         SubsamplingLayer, ZeroPaddingLayer)
+from ..nn.layers import (ActivationLayer, BatchNormalization,
+                         Convolution1DLayer, ConvolutionLayer, DenseLayer,
+                         DropoutLayer, EmbeddingLayer, GlobalPoolingLayer,
+                         LSTM, OutputLayer, Subsampling1DLayer,
+                         SubsamplingLayer, ZeroPadding1DLayer,
+                         ZeroPaddingLayer)
 from ..nn.multilayer import MultiLayerNetwork
 
 _ACT_MAP = {
@@ -123,9 +127,62 @@ class KerasLayerTranslator:
             return SubsamplingLayer(pooling_type=pt, kernel_size=k, stride=s,
                                     convolution_mode="same" if border == "same"
                                     else "truncate")
-        if klass in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+        if klass in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
+                     "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
             return GlobalPoolingLayer(pooling_type="avg" if "Average" in klass
                                       else "max")
+        if klass in ("Convolution1D", "Conv1D"):
+            n_out = cfg.get("nb_filter") or cfg.get("filters")
+            k = cfg.get("filter_length") or cfg.get("kernel_size")
+            if isinstance(k, (list, tuple)):
+                k = k[0]
+            s = cfg.get("subsample_length") or cfg.get("strides") or 1
+            if isinstance(s, (list, tuple)):
+                s = s[0]
+            border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+            if border == "causal":
+                raise ValueError("Conv1D padding='causal' is not supported "
+                                 "(reference Keras-1 registry has valid/same "
+                                 "only, KerasConvolution translator)")
+            return Convolution1DLayer(
+                n_out=int(n_out), kernel_size=int(k), stride=int(s),
+                convolution_mode="same" if border == "same" else "truncate",
+                activation=_keras_act(cfg))
+        if klass in ("MaxPooling1D", "AveragePooling1D"):
+            k = cfg.get("pool_length") or cfg.get("pool_size") or 2
+            if isinstance(k, (list, tuple)):
+                k = k[0]
+            s = cfg.get("stride") or cfg.get("strides") or k
+            if isinstance(s, (list, tuple)):
+                s = s[0]
+            border = cfg.get("border_mode") or cfg.get("padding") or "valid"
+            return Subsampling1DLayer(
+                pooling_type="max" if klass.startswith("Max") else "avg",
+                kernel_size=int(k), stride=int(s),
+                convolution_mode="same" if border == "same" else "truncate")
+        if klass == "ZeroPadding1D":
+            pad = cfg.get("padding", 1)
+            if isinstance(pad, (list, tuple)):
+                return ZeroPadding1DLayer(padding=tuple(int(v) for v in pad))
+            return ZeroPadding1DLayer(padding=int(pad))
+        if klass == "TimeDistributed":
+            # reference KerasLayer.java:69 LAYER_CLASS_NAME_TIME_DISTRIBUTED_
+            # DENSE: only the Dense wrapper is in the registry. Our DenseLayer
+            # is natively time-distributed over [B,T,F] (broadcast matmul),
+            # so the wrapper dissolves to a DenseLayer.
+            inner = cfg.get("layer") or {}
+            if inner.get("class_name") != "Dense":
+                raise ValueError(
+                    f"TimeDistributed({inner.get('class_name')!r}) is not "
+                    f"supported (reference covers TimeDistributed(Dense) only)")
+            icfg = inner.get("config", {})
+            n_out = icfg.get("output_dim") or icfg.get("units")
+            if is_output:
+                from ..nn.layers import RnnOutputLayer
+                return RnnOutputLayer(n_out=int(n_out),
+                                      activation=_keras_act(icfg),
+                                      loss=_keras_loss(loss, self.enforce))
+            return DenseLayer(n_out=int(n_out), activation=_keras_act(icfg))
         if klass == "Dropout":
             p = cfg.get("p") or cfg.get("rate") or 0.5
             return DropoutLayer(dropout=1.0 - float(p))  # keras p = drop prob
@@ -266,12 +323,14 @@ def _assign_layer_arrays(layer, arrays, pdict, sdict, dim_ordering):
     """Write one Keras layer's weight arrays into a (params, state) dict pair
     (reference KerasModel.java:510-523 copyWeightsToModel). Shared by the
     Sequential (MLN) and functional (ComputationGraph) import paths."""
-    from ..nn.layers import (BatchNormalization, ConvolutionLayer,
-                             DenseLayer, EmbeddingLayer, LSTM)
-    if isinstance(layer, ConvolutionLayer):
+    from ..nn.layers import (BatchNormalization, Convolution1DLayer,
+                             ConvolutionLayer, DenseLayer, EmbeddingLayer,
+                             LSTM)
+    if isinstance(layer, (ConvolutionLayer, Convolution1DLayer)):
         W = arrays[0]
         if W.ndim == 4 and dim_ordering == "th":
             W = W.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        # keras Conv1D kernels are (k, in, out) = our WIO already
         pdict["W"] = np_cast(W, pdict["W"])
         if len(arrays) > 1:
             pdict["b"] = np_cast(arrays[1], pdict["b"])

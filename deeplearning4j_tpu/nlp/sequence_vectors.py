@@ -7,11 +7,13 @@ VectorCalculationsThreads (:287-302), linear LR decay; the SkipGram hot loop
 is a native ND4J Aggregate (SkipGram.java:271, AggregateSkipGram).
 
 TPU-shaped replacement (SURVEY.md §2.6.6, §7 stage 9): training pairs are
-generated host-side in large batches; ONE jitted negative-sampling step does
-a batched gather -> dot -> scatter-add update on device. Hierarchical softmax
-is replaced by negative sampling as the default objective (the reference
-supports both; HS's pointer-chasing tree walk is hostile to the MXU — vocab
-Huffman machinery is retained in VocabCache for parity).
+generated host-side in large batches; ONE jitted step does a batched
+gather -> dot -> scatter-add update on device. Both of the reference's
+objectives are supported: negative sampling (default), and hierarchical
+softmax (``use_hierarchical_softmax=True``, reference useHierarchicSoftmax)
+— the per-word Huffman tree walk becomes a rectangular [B, max_code_len]
+gather over padded paths (VocabCache.huffman_arrays), which keeps the HS
+update MXU/scatter-friendly instead of pointer-chasing.
 
 Word2Vec / ParagraphVectors / DeepWalk all ride this engine, exactly like the
 reference's class hierarchy.
@@ -52,6 +54,28 @@ def _sgns_grads(v, u_pos, u_neg):
     return grad_v, grad_u_pos, grad_u_neg, loss_row
 
 
+def _hs_grads(v, u_path, codes, path_mask):
+    """Analytic hierarchical-softmax gradients on the GATHERED inner-node rows
+    (reference SkipGram.java:238ff HS branch, TPU-batched: the per-word tree
+    walk becomes one [B,L] gather over Huffman paths padded to the max code
+    length; padded entries are masked to zero so their scatter-add is a no-op).
+
+    v: [B,D] predictor rows; u_path: [B,L,D] inner-node rows along the target
+    word's Huffman path; codes: [B,L] Huffman bits; path_mask: [B,L].
+    word2vec convention: label = 1 - code, loss = softplus((2*code-1)*logit).
+    Returns (grad_v, grad_u [B,L,D], loss_row [B]).
+    """
+    import jax
+    import jax.numpy as jnp
+    logits = jnp.einsum("bd,bld->bl", v, u_path)
+    g = (jax.nn.sigmoid(logits) - (1.0 - codes)) * path_mask  # dL/dlogit
+    grad_v = jnp.einsum("bl,bld->bd", g, u_path)
+    grad_u = g[..., None] * v[:, None, :]
+    loss_row = jnp.sum(jax.nn.softplus((2.0 * codes - 1.0) * logits)
+                       * path_mask, axis=-1)
+    return grad_v, grad_u, loss_row
+
+
 def make_neg_sampling_step(lr: float, negative: int):
     """Standalone jitted SkipGram-NS step with on-device uniform negative
     sampling — the benchmark/bulk-throughput entry point (training proper uses
@@ -80,7 +104,8 @@ class SequenceVectors:
                  negative: int = 5, sample: float = 0.0,
                  learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
                  batch_size: int = 8192, seed: int = 42,
-                 learning_algorithm: str = "skipgram"):
+                 learning_algorithm: str = "skipgram",
+                 use_hierarchical_softmax: bool = False):
         self.layer_size = layer_size
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -93,12 +118,28 @@ class SequenceVectors:
         self.batch_size = batch_size
         self.seed = seed
         self.learning_algorithm = learning_algorithm.lower()
+        # reference Word2Vec.Builder useHierarchicSoftmax (SkipGram.java:238ff
+        # HS branch): train over the Huffman tree instead of sampled negatives
+        self.use_hierarchical_softmax = use_hierarchical_softmax
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[np.ndarray] = None
-        self.syn1neg: Optional[np.ndarray] = None
+        self.syn1neg: Optional[np.ndarray] = None   # NS output table
+        self.syn1: Optional[np.ndarray] = None      # HS inner-node table
+        self._huffman = None                        # (codes, points, mask)
         self._step = None
 
     # ------------------------------------------------------------- training
+    def _ensure_hs_tables(self):
+        """Lazily build the padded Huffman path arrays and the inner-node
+        output table (single owner of the max(V-1,1) shape; shared by the
+        Word2Vec, PV-DBOW, PV-DM and infer_vector HS paths)."""
+        if self._huffman is None:
+            self._huffman = self.vocab.huffman_arrays()
+        if self.syn1 is None:
+            self.syn1 = np.zeros((max(len(self.vocab) - 1, 1),
+                                  self.layer_size), np.float32)
+        return self._huffman
+
     def _build_step(self):
         """Jitted batched SGNS step with scatter-add-only table updates: the
         gradient is derived analytically on the gathered rows (_sgns_grads) so
@@ -111,6 +152,30 @@ class SequenceVectors:
         import jax.numpy as jnp
 
         cbow = self.learning_algorithm == "cbow"
+
+        if self.use_hierarchical_softmax:
+            # HS variant: same scatter-add-only shape, but the output-side
+            # gather walks the target word's padded Huffman path over the
+            # inner-node table (reference syn1 vs syn1neg split).
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def hs_step(syn0, syn1, centers, pts, cds, msk, lr, ctx_mask=None):
+                D = syn0.shape[1]
+                if cbow:
+                    denom = jnp.clip(ctx_mask.sum(1, keepdims=True), 1.0, None)
+                    v = (syn0[centers] * ctx_mask[..., None]).sum(1) / denom
+                else:
+                    v = syn0[centers]
+                grad_v, grad_u, loss_row = _hs_grads(v, syn1[pts], cds, msk)
+                syn1 = syn1.at[pts.reshape(-1)].add(-lr * grad_u.reshape(-1, D))
+                if cbow:
+                    per_ctx = grad_v[:, None, :] * (ctx_mask / denom)[..., None]
+                    syn0 = syn0.at[centers.reshape(-1)].add(
+                        -lr * per_ctx.reshape(-1, D))
+                else:
+                    syn0 = syn0.at[centers].add(-lr * grad_v)
+                return syn0, syn1, jnp.sum(loss_row) / centers.shape[0]
+
+            return hs_step
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(syn0, syn1, centers, contexts, negs, lr, ctx_mask=None):
@@ -167,15 +232,20 @@ class SequenceVectors:
         V, D = len(self.vocab), self.layer_size
         rng = np.random.default_rng(self.seed)
         self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
-        self.syn1neg = np.zeros((V, D), np.float32)
-        table = self.vocab.unigram_table()
+        if self.use_hierarchical_softmax:
+            self._huffman = self.syn1 = None   # fresh fit: rebuild both
+            self._ensure_hs_tables()
+            syn1_host, table = self.syn1, None
+        else:
+            self.syn1neg = np.zeros((V, D), np.float32)
+            syn1_host, table = self.syn1neg, self.vocab.unigram_table()
         keep_probs = self.vocab.subsample_keep_probs(self.sample)
         if self._step is None:
             self._step = self._build_step()
 
         idx_seqs = [np.asarray([self.vocab.index_of(w) for w in s
                                 if w in self.vocab], np.int32) for s in seqs]
-        syn0, syn1 = jnp.asarray(self.syn0), jnp.asarray(self.syn1neg)
+        syn0, syn1 = jnp.asarray(self.syn0), jnp.asarray(syn1_host)
         total_steps = max(1, self.epochs * self.iterations * len(idx_seqs))
         done = 0
         for _ in range(self.epochs):
@@ -196,7 +266,10 @@ class SequenceVectors:
                     syn0, syn1 = self._flush(syn0, syn1, buf, table, rng,
                                              done / total_steps)
         self.syn0 = np.asarray(syn0)
-        self.syn1neg = np.asarray(syn1)
+        if self.use_hierarchical_softmax:
+            self.syn1 = np.asarray(syn1)
+        else:
+            self.syn1neg = np.asarray(syn1)
         return self
 
     def _flush(self, syn0, syn1, buf, table, rng, progress):
@@ -204,6 +277,22 @@ class SequenceVectors:
         pairs = np.concatenate(buf)
         lr = max(self.min_learning_rate,
                  self.learning_rate * (1.0 - progress))
+        if self.use_hierarchical_softmax:
+            codes, points, pmask = self._huffman
+            if self.learning_algorithm == "cbow":
+                # pairs are (target, context): 1-context cbow predicts the
+                # target word's Huffman path from the context row
+                tgt = pairs[:, 0]
+                centers = pairs[:, 1][:, None]
+                cmask = jnp.ones((len(pairs), 1), jnp.float32)
+            else:
+                # skipgram: center row predicts the CONTEXT word's path
+                tgt = pairs[:, 1]
+                centers, cmask = pairs[:, 0], None
+            syn0, syn1, _ = self._step(
+                syn0, syn1, jnp.asarray(centers), jnp.asarray(points[tgt]),
+                jnp.asarray(codes[tgt]), jnp.asarray(pmask[tgt]), lr, cmask)
+            return syn0, syn1
         negs = table[rng.integers(0, len(table), (len(pairs), self.negative))]
         if self.learning_algorithm == "cbow":
             # for cbow the "pairs" are (target, context); group by target is

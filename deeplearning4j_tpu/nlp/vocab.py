@@ -102,6 +102,27 @@ class VocabCache:
             vw.code = code[::-1]
             vw.points = points[::-1]
 
+    def huffman_arrays(self):
+        """Pad the per-word Huffman paths to rectangular arrays for the
+        batched-gather hierarchical-softmax step (the TPU-shaped form of the
+        reference's per-word tree walk, SkipGram.java:238ff):
+        (codes [V,L] float32, points [V,L] int32, mask [V,L] float32) with
+        L = max code length. Padded entries point at inner node 0 with mask 0,
+        so their scatter-add contribution is exactly zero."""
+        if self._by_index and not self._by_index[0].code and len(self._by_index) > 1:
+            self.build_huffman()
+        V = len(self._by_index)
+        L = max((len(vw.code) for vw in self._by_index), default=1) or 1
+        codes = np.zeros((V, L), np.float32)
+        points = np.zeros((V, L), np.int32)
+        mask = np.zeros((V, L), np.float32)
+        for i, vw in enumerate(self._by_index):
+            n = len(vw.code)
+            codes[i, :n] = vw.code
+            points[i, :n] = vw.points
+            mask[i, :n] = 1.0
+        return codes, points, mask
+
     def unigram_table(self, size: int = 1 << 20, power: float = 0.75) -> np.ndarray:
         """Negative-sampling table (word2vec unigram^0.75 distribution; the
         reference delegates this to ND4J's native AggregateSkipGram)."""

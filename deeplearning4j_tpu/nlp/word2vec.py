@@ -85,12 +85,19 @@ class ParagraphVectors(SequenceVectors):
                 self._step = self._build_step()
         if self.dm:
             return self._fit_dm(docs_tok)
-        # 2) PV-DBOW: doc vector predicts its words against syn1neg
+        # 2) PV-DBOW: doc vector predicts its words — against syn1neg
+        # (negative sampling) or the Huffman inner-node table (HS), exactly
+        # the objective the word phase used
+        hs = self.use_hierarchical_softmax
         rng = np.random.default_rng(self.seed + 1)
         D = self.layer_size
         dvec = ((rng.random((len(docs_tok), D)) - 0.5) / D).astype(np.float32)
-        table = self.vocab.unigram_table()
-        syn1 = jnp.asarray(self.syn1neg)
+        if hs:
+            codes, points, pmask = self._ensure_hs_tables()
+            syn1, table = jnp.asarray(self.syn1), None
+        else:
+            table = self.vocab.unigram_table()
+            syn1 = jnp.asarray(self.syn1neg)
         dvec = jnp.asarray(dvec)
         step = self._step
         for epoch in range(max(1, self.epochs)):
@@ -106,13 +113,23 @@ class ParagraphVectors(SequenceVectors):
                      self.learning_rate * (1 - epoch / max(1, self.epochs)))
             for s in range(0, len(pairs), self.batch_size):
                 chunk = pairs[s:s + self.batch_size]
-                negs = table[rng.integers(0, len(table),
-                                          (len(chunk), self.negative))]
-                dvec, syn1, _ = step(dvec, syn1, jnp.asarray(chunk[:, 0]),
-                                     jnp.asarray(chunk[:, 1]),
-                                     jnp.asarray(negs), lr)
+                if hs:
+                    w = chunk[:, 1]
+                    dvec, syn1, _ = step(dvec, syn1, jnp.asarray(chunk[:, 0]),
+                                         jnp.asarray(points[w]),
+                                         jnp.asarray(codes[w]),
+                                         jnp.asarray(pmask[w]), lr)
+                else:
+                    negs = table[rng.integers(0, len(table),
+                                              (len(chunk), self.negative))]
+                    dvec, syn1, _ = step(dvec, syn1, jnp.asarray(chunk[:, 0]),
+                                         jnp.asarray(chunk[:, 1]),
+                                         jnp.asarray(negs), lr)
         self.doc_vectors = np.asarray(dvec)
-        self.syn1neg = np.asarray(syn1)
+        if hs:
+            self.syn1 = np.asarray(syn1)
+        else:
+            self.syn1neg = np.asarray(syn1)
         return self
 
     def _fit_dm(self, docs_tok):
@@ -128,12 +145,18 @@ class ParagraphVectors(SequenceVectors):
         combined = jnp.asarray(np.vstack([np.asarray(self.syn0), dvec]))
         # targets/negatives are always word indices < V, so syn1 needs no
         # doc rows
-        syn1 = jnp.asarray(self.syn1neg)
-        table = self.vocab.unigram_table()
+        hs = self.use_hierarchical_softmax
+        if hs:
+            codes, points, pmask = self._ensure_hs_tables()
+            syn1, table = jnp.asarray(self.syn1), None
+        else:
+            syn1 = jnp.asarray(self.syn1neg)
+            table = self.vocab.unigram_table()
         C = 2 * self.window + 1          # window words + doc row
         cbow_step = SequenceVectors(
             layer_size=D, window=self.window, negative=self.negative,
-            learning_algorithm="cbow")._build_step()
+            learning_algorithm="cbow",
+            use_hierarchical_softmax=hs)._build_step()
         idx_docs = [np.asarray([self.vocab.index_of(w) for w in toks
                                 if w in self.vocab], np.int32)
                     for _, toks in docs_tok]
@@ -157,15 +180,25 @@ class ParagraphVectors(SequenceVectors):
             ctr, msk, tgt = ctr_all[order], msk_all[order], tgt_all[order]
             for s in range(0, len(ctr), self.batch_size):
                 sl = slice(s, s + self.batch_size)
-                negs = table[rng.integers(0, len(table),
-                                          (len(tgt[sl]), self.negative))]
-                combined, syn1, _ = cbow_step(
-                    combined, syn1, jnp.asarray(ctr[sl]), jnp.asarray(tgt[sl]),
-                    jnp.asarray(negs), lr, jnp.asarray(msk[sl]))
+                if hs:
+                    w = tgt[sl]
+                    combined, syn1, _ = cbow_step(
+                        combined, syn1, jnp.asarray(ctr[sl]),
+                        jnp.asarray(points[w]), jnp.asarray(codes[w]),
+                        jnp.asarray(pmask[w]), lr, jnp.asarray(msk[sl]))
+                else:
+                    negs = table[rng.integers(0, len(table),
+                                              (len(tgt[sl]), self.negative))]
+                    combined, syn1, _ = cbow_step(
+                        combined, syn1, jnp.asarray(ctr[sl]), jnp.asarray(tgt[sl]),
+                        jnp.asarray(negs), lr, jnp.asarray(msk[sl]))
         combined = np.asarray(combined)
         self.syn0 = combined[:V]
         self.doc_vectors = combined[V:]
-        self.syn1neg = np.asarray(syn1)
+        if hs:
+            self.syn1 = np.asarray(syn1)
+        else:
+            self.syn1neg = np.asarray(syn1)
         return self
 
     def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
@@ -190,9 +223,12 @@ class ParagraphVectors(SequenceVectors):
                          self.layer_size).astype(np.float32))
         if len(widx) == 0:
             return np.asarray(v)
+        lr = learning_rate or self.learning_rate
+
+        if self.use_hierarchical_softmax:
+            return self._infer_vector_hs(v, widx, steps, lr)
         syn1 = jnp.asarray(self.syn1neg)
         table = self.vocab.unigram_table()
-        lr = learning_rate or self.learning_rate
 
         if self.dm:
             W = self.window
@@ -233,6 +269,50 @@ class ParagraphVectors(SequenceVectors):
             negs = table[rng.integers(0, len(table), (len(widx), self.negative))]
             v = one(v, jnp.asarray(widx), jnp.asarray(negs),
                     lr * (1 - s / steps) + 1e-4)
+        return np.asarray(v)
+
+    def _infer_vector_hs(self, v, widx, steps, lr):
+        """HS variant of infer_vector: gradient descent on the deterministic
+        Huffman-path loss of the text's words against the frozen inner-node
+        table (no negative resampling needed — the HS loss has no sampled
+        terms)."""
+        import jax
+        import jax.numpy as jnp
+        codes, points, pmask = self._ensure_hs_tables()
+        syn1 = jnp.asarray(self.syn1)
+        pts = jnp.asarray(points[widx])     # [T, L]
+        cds = jnp.asarray(codes[widx])
+        msk = jnp.asarray(pmask[widx])
+        u = syn1[pts]                        # [T, L, D]
+
+        if self.dm:
+            W = self.window
+            ctx_sum = np.zeros((len(widx), self.layer_size), np.float32)
+            n_ctx = np.zeros((len(widx), 1), np.float32)
+            s0 = np.asarray(self.syn0)
+            for t in range(len(widx)):
+                lo, hi = max(0, t - W), min(len(widx), t + W + 1)
+                ctx = [widx[j] for j in range(lo, hi) if j != t]
+                if ctx:
+                    ctx_sum[t] = s0[ctx].sum(0)
+                n_ctx[t, 0] = len(ctx)
+            ctx_sum = jnp.asarray(ctx_sum)
+            denom = jnp.asarray(n_ctx + 1.0)
+
+            def lf(v):
+                mean_vec = (ctx_sum + v[None, :]) / denom          # [T, D]
+                logits = jnp.einsum("td,tld->tl", mean_vec, u)
+                return jnp.mean(jnp.sum(
+                    jax.nn.softplus((2.0 * cds - 1.0) * logits) * msk, -1))
+        else:
+            def lf(v):
+                logits = jnp.einsum("d,tld->tl", v, u)
+                return jnp.mean(jnp.sum(
+                    jax.nn.softplus((2.0 * cds - 1.0) * logits) * msk, -1))
+
+        one = jax.jit(lambda v, lr: v - lr * jax.grad(lf)(v))
+        for s in range(steps):
+            v = one(v, lr * (1 - s / steps) + 1e-4)
         return np.asarray(v)
 
     def similarity_to_label(self, text: str, label: str) -> float:

@@ -151,21 +151,30 @@ class GraphBuilder:
             for name in order:
                 v = self._vertices[name]
                 in_types = [itypes[i] for i in self._vertex_inputs[name]]
-                if isinstance(v, LayerVertex):
-                    if v.preprocessor is None:
-                        pre, new_it = auto_preprocessor(in_types[0],
-                                                        v.layer_conf.expected_input)
-                        if pre is not None:
-                            v.preprocessor = pre
-                        in_types = [new_it] + in_types[1:]
+                # Eager validation (reference nn/conf/layers/LayerValidation.java
+                # + ComputationGraphConfiguration validation): a malformed graph
+                # fails at build() naming the offending vertex, instead of as an
+                # opaque shape error at first trace.
+                try:
+                    if isinstance(v, LayerVertex):
+                        if v.preprocessor is None:
+                            pre, new_it = auto_preprocessor(in_types[0],
+                                                            v.layer_conf.expected_input)
+                            if pre is not None:
+                                v.preprocessor = pre
+                            in_types = [new_it] + in_types[1:]
+                        else:
+                            in_types = [v.preprocessor.output_type(in_types[0])] + in_types[1:]
+                        if getattr(v.layer_conf, "n_in", "absent") is None:
+                            from .config import _infer_n_in
+                            v.layer_conf.n_in = _infer_n_in(v.layer_conf, in_types[0])
+                        itypes[name] = v.layer_conf.output_type(in_types[0])
                     else:
-                        in_types = [v.preprocessor.output_type(in_types[0])] + in_types[1:]
-                    if getattr(v.layer_conf, "n_in", "absent") is None:
-                        from .config import _infer_n_in
-                        v.layer_conf.n_in = _infer_n_in(v.layer_conf, in_types[0])
-                    itypes[name] = v.layer_conf.output_type(in_types[0])
-                else:
-                    itypes[name] = v.output_type(in_types)
+                        itypes[name] = v.output_type(in_types)
+                except ValueError as e:
+                    raise ValueError(
+                        f"Invalid configuration at vertex {name!r} "
+                        f"(inputs {self._vertex_inputs[name]}): {e}") from e
 
         nc = self.nn_conf
         return ComputationGraphConfiguration(

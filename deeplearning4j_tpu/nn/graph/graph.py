@@ -68,10 +68,19 @@ class ComputationGraph:
             p, s = v.init(sub, in_types, dtype)
             params.append(p)
             state.append(s)
-            try:
-                itypes[name] = (v.output_type(in_types)
-                                if all(t is not None for t in in_types) else None)
-            except Exception:
+            if all(t is not None for t in in_types):
+                # Eager per-vertex shape validation (reference
+                # nn/conf/layers/LayerValidation.java): a config whose shapes
+                # don't line up must fail HERE naming the vertex, not as an
+                # opaque trace-time error inside the first jitted step.
+                try:
+                    itypes[name] = v.output_type(in_types)
+                except Exception as e:
+                    raise ValueError(
+                        f"Shape inference failed at vertex {name!r} "
+                        f"(inputs {self.conf.vertex_inputs[name]} -> "
+                        f"{in_types}): {e}") from e
+            else:
                 itypes[name] = None
         self.params = tuple(params)
         self.state = tuple(state)

@@ -106,6 +106,13 @@ class MergeVertex(VertexConf):
     def output_type(self, itypes):
         it0 = itypes[0]
         if isinstance(it0, InputTypeConvolutional):
+            bad = [i for i in itypes
+                   if not isinstance(i, InputTypeConvolutional)
+                   or (i.height, i.width) != (it0.height, it0.width)]
+            if bad:
+                raise ValueError(
+                    f"MergeVertex concatenates channels, so all inputs must be "
+                    f"convolutional with equal spatial dims; got {itypes}")
             return InputTypeConvolutional(it0.height, it0.width,
                                           sum(i.channels for i in itypes))
         if isinstance(it0, InputTypeRecurrent):
@@ -121,6 +128,23 @@ class MergeVertex(VertexConf):
 class ElementWiseVertex(VertexConf):
     """Elementwise add/subtract/product/average/max (reference ElementWiseVertex)."""
     op: str = "add"
+
+    def output_type(self, itypes):
+        # reference ElementWiseVertex.getOutputType: all inputs must agree.
+        # Conv inputs must match on the FULL (h, w, c) shape; across families
+        # the runtime arrays only need equal flat size (e.g. ConvolutionalFlat
+        # merged with an equal-width FeedForward branch is a valid [B,N] add).
+        def sig(it):
+            if isinstance(it, InputTypeConvolutional):
+                return ("cnn", it.height, it.width, it.channels)
+            if isinstance(it, InputTypeRecurrent):
+                return ("rnn", it.size)
+            return ("flat", it.flat_size())
+        if len({sig(i) for i in itypes}) > 1:
+            raise ValueError(
+                f"ElementWiseVertex({self.op}) requires same-shaped inputs; "
+                f"got {itypes}")
+        return itypes[0]
 
     def apply(self, params, state, inputs, *, train=False, rng=None):
         op = self.op.lower()
@@ -141,6 +165,25 @@ class ElementWiseVertex(VertexConf):
         else:
             raise ValueError(f"Unknown elementwise op {self.op!r}")
         return out, state
+
+
+@register
+@dataclass
+class PoolHelperVertex(VertexConf):
+    """Strip the first spatial row and column of a pooled activation
+    (reference nn/graph/vertex/impl/PoolHelperVertex.java — compensates the
+    off-by-one pooling of Caffe-trained inception models at import). NHWC
+    here, so x[:, 1:, 1:, :] (the reference is NCHW x[:, :, 1:, 1:])."""
+
+    def output_type(self, itypes):
+        it = itypes[0]
+        if not isinstance(it, InputTypeConvolutional):
+            raise ValueError(f"PoolHelperVertex expects convolutional input, "
+                             f"got {it}")
+        return InputTypeConvolutional(it.height - 1, it.width - 1, it.channels)
+
+    def apply(self, params, state, inputs, *, train=False, rng=None):
+        return inputs[0][:, 1:, 1:, :], state
 
 
 @register

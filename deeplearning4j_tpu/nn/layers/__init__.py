@@ -3,7 +3,8 @@ from .core import (ActivationLayer, AutoEncoder, CenterLossOutputLayer,
                    DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
                    OutputLayer, RnnOutputLayer)
 from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
-                   SubsamplingLayer, Subsampling1DLayer, ZeroPaddingLayer)
+                   SubsamplingLayer, Subsampling1DLayer, ZeroPadding1DLayer,
+                   ZeroPaddingLayer)
 from .norm import BatchNormalization, LocalResponseNormalization
 from .attention import SelfAttentionLayer
 from .recurrent import (GravesBidirectionalLSTM, GravesLSTM, LSTM,
@@ -23,6 +24,7 @@ __all__ = [
     "DenseLayer", "DropoutLayer", "EmbeddingLayer", "LossLayer", "OutputLayer",
     "RnnOutputLayer", "Convolution1DLayer", "ConvolutionLayer",
     "GlobalPoolingLayer", "SubsamplingLayer", "Subsampling1DLayer",
-    "ZeroPaddingLayer", "BatchNormalization", "LocalResponseNormalization",
+    "ZeroPadding1DLayer", "ZeroPaddingLayer", "BatchNormalization",
+    "LocalResponseNormalization",
     "GravesBidirectionalLSTM", "GravesLSTM", "LSTM", "LastTimeStepLayer",
 ]

@@ -179,7 +179,15 @@ class SubsamplingLayer(LayerConf):
         elif pt in ("avg", "sum"):
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if pt == "avg":
-                y = y / (kh * kw)
+                if pad == "SAME":
+                    # exclude implicit padding from the denominator (TF/Keras
+                    # semantics; windows at the edge average over fewer cells)
+                    ones = jnp.ones(x.shape[:1] + x.shape[1:3] + (1,), x.dtype)
+                    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                            pad)
+                    y = y / cnt
+                else:
+                    y = y / (kh * kw)
         elif pt == "pnorm":
             p = float(self.pnorm)
             y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad) ** (1.0 / p)
@@ -217,7 +225,14 @@ class Subsampling1DLayer(LayerConf):
         elif pt in ("avg", "sum"):
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if pt == "avg":
-                y = y / k
+                if pad == "SAME":
+                    # exclude implicit padding (TF/Keras edge semantics)
+                    ones = jnp.ones(x.shape[:2] + (1,), x.dtype)
+                    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                            pad)
+                    y = y / cnt
+                else:
+                    y = y / k
         elif pt == "pnorm":
             pw = float(self.pnorm)
             y = lax.reduce_window(jnp.abs(x) ** pw, 0.0, lax.add, dims, strides, pad) ** (1.0 / pw)
@@ -249,6 +264,33 @@ class ZeroPaddingLayer(LayerConf):
     def apply(self, params, state, x, *, train=False, rng=None):
         t, b, l, r = self._pads()
         return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register
+@dataclass
+class ZeroPadding1DLayer(LayerConf):
+    """Temporal zero padding over [B,T,F] (reference
+    nn/conf/layers — Keras registry ZeroPadding1D, KerasLayer.java:53-70).
+    padding = int (symmetric) or (left, right)."""
+    padding: Tuple[int, ...] = (0, 0)
+
+    expected_input: ClassVar[str] = "rnn"
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, int):
+            return (p, p)
+        p = tuple(int(v) for v in p)
+        return (p[0], p[0]) if len(p) == 1 else p
+
+    def output_type(self, itype):
+        l, r = self._pads()
+        t = itype.timestep_length
+        return InputTypeRecurrent(itype.size, t + l + r if t and t > 0 else t)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
 
 
 @register

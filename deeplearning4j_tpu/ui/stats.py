@@ -187,17 +187,20 @@ class StatsListener(TrainingListener):
         out: Dict[str, Any] = {}
         try:
             import resource
+        except ImportError:   # non-POSIX platform
+            pass
+        else:
             out["host_rss_mb"] = resource.getrusage(
                 resource.RUSAGE_SELF).ru_maxrss / 1024.0
-        except Exception:
-            pass
         try:
             ms = jax.local_devices()[0].memory_stats()
-            if ms:
-                out["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
-                out["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
-        except Exception:
-            pass
+        except (AttributeError, NotImplementedError, RuntimeError):
+            # backends without PJRT memory stats (e.g. CPU) either raise or
+            # have no memory_stats(); anything else is a real bug — surface it
+            ms = None
+        if ms:
+            out["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+            out["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
         return out
 
     def _post_static(self, model):
@@ -246,15 +249,17 @@ class StatsListener(TrainingListener):
             if acts is not None:
                 update["activations"] = acts
         if self.config.collect_learning_rates:
-            try:
-                upd = getattr(model, "updater", None)
-                if upd is not None and hasattr(upd, "layer_confs"):
-                    lrs = {str(i): float(upd.rule_for(c).lr(iteration))
-                           for i, c in enumerate(upd.layer_confs)}
-                    if lrs:
-                        update["learning_rates"] = lrs
-            except Exception:
-                pass
+            upd = getattr(model, "updater", None)
+            if upd is not None and hasattr(upd, "layer_confs"):
+                lrs = {}
+                for i, c in enumerate(upd.layer_confs):
+                    rule = upd.rule_for(c)
+                    # rules without a schedule surface (e.g. NoOp) are skipped;
+                    # a broken schedule raising inside lr() must propagate
+                    if hasattr(rule, "lr"):
+                        lrs[str(i)] = float(rule.lr(iteration))
+                if lrs:
+                    update["learning_rates"] = lrs
         self.storage.put_update(self.session_id, self.worker_id, update)
         self._last_report_time = now
         self._iters_since_report = 0

@@ -194,3 +194,21 @@ def test_transfer_learning_graph():
     assert not np.allclose(
         np.asarray(new.params[new.vertex_names.index("out")]["W"]),
         head_before)
+
+
+def test_malformed_graph_fails_at_build_naming_vertex():
+    """Eager config validation (reference nn/conf/layers/LayerValidation.java):
+    a shape mismatch fails at .build() naming the offending vertex, not as an
+    opaque trace-time error."""
+    b = (NeuralNetConfiguration(seed=5, updater=Sgd(0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_out=16, activation="tanh"), "in")
+         .add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_vertex("ew", ElementWiseVertex(op="add"), "d1", "d2")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "ew")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)))
+    with pytest.raises(ValueError, match="'ew'"):
+        b.build()

@@ -208,3 +208,118 @@ def test_import_keras_applications_resnet50_vgg16(tmp_path):
         k = np.asarray(model.predict(x, verbose=0))
         o = np.asarray(graph.output(x))
         assert np.allclose(k, o, atol=1e-4), np.abs(k - o).max()
+
+
+def test_import_sequential_conv1d_stack(tmp_path):
+    """1D translator tail (reference KerasLayer.java:53-70 registry):
+    Conv1D + MaxPooling1D + GlobalMaxPooling1D prediction parity."""
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(12, 5)),
+        layers.Conv1D(8, 3, padding="same", activation="relu"),
+        layers.MaxPooling1D(2),
+        layers.Conv1D(6, 3, padding="valid", activation="tanh"),
+        layers.GlobalMaxPooling1D(),
+        layers.Dense(3, activation="softmax"),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "conv1d.h5")
+    _save_h5(model, path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(8).normal(size=(4, 12, 5)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-4), np.abs(keras_out - ours).max()
+
+
+def test_import_sequential_zeropad1d_avgpool1d(tmp_path):
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(10, 4)),
+        layers.ZeroPadding1D(2),
+        layers.Conv1D(6, 3, padding="valid", activation="relu"),
+        layers.AveragePooling1D(2),
+        layers.GlobalAveragePooling1D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "zp1d.h5")
+    _save_h5(model, path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(9).normal(size=(3, 10, 4)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-4), np.abs(keras_out - ours).max()
+
+
+def test_import_time_distributed_dense(tmp_path):
+    """TimeDistributed(Dense) (reference KerasLayer.java:69): dissolves to the
+    natively time-distributed DenseLayer; as the last layer it becomes the
+    RnnOutputLayer scoring head."""
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(6, 4)),
+        layers.LSTM(5, return_sequences=True),
+        layers.TimeDistributed(layers.Dense(3, activation="softmax")),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "td.h5")
+    _save_h5(model, path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(10).normal(size=(2, 6, 4)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    assert ours.shape == (2, 6, 3)
+    assert np.allclose(keras_out, ours, atol=1e-4), np.abs(keras_out - ours).max()
+    # trainable: scoring head wired to the time axis
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(11).integers(0, 3, (2, 6))]
+    assert np.isfinite(net.score(x, y))
+
+
+def test_pool_helper_vertex():
+    """PoolHelperVertex strips the first row+column (reference
+    nn/graph/vertex/impl/PoolHelperVertex.java, NHWC here)."""
+    from deeplearning4j_tpu.nn.graph.vertices import PoolHelperVertex
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import OutputLayer, GlobalPoolingLayer
+
+    b = (NeuralNetConfiguration(seed=3, updater=Sgd(0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("c", ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                          convolution_mode="same"), "in")
+         .add_vertex("ph", PoolHelperVertex(), "c")
+         .add_layer("gp", GlobalPoolingLayer(pooling_type="avg"), "ph")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "gp")
+         .set_outputs("out")
+         .set_input_types(InputType.convolutional(6, 6, 2)))
+    net = ComputationGraph(b.build()).init()
+    x = np.random.default_rng(12).normal(size=(2, 6, 6, 2)).astype(np.float32)
+    acts = net.feed_forward(x)
+    assert np.asarray(acts["ph"]).shape == (2, 5, 5, 3)
+    assert np.asarray(net.output(x)).shape == (2, 2)
+
+
+def test_import_avgpool1d_same_odd_length(tmp_path):
+    """AveragePooling1D(padding='same') over an odd-length sequence: edge
+    windows must average over the VALID frames only (TF/Keras semantics)."""
+    from keras import layers
+    model = keras.Sequential([
+        keras.Input(shape=(7, 3)),
+        layers.AveragePooling1D(2, padding="same"),
+        layers.GlobalAveragePooling1D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    model.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = str(tmp_path / "ap1same.h5")
+    _save_h5(model, path)
+    net = import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(13).normal(size=(3, 7, 3)).astype(np.float32)
+    keras_out = np.asarray(model.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    assert np.allclose(keras_out, ours, atol=1e-5), np.abs(keras_out - ours).max()

@@ -15,9 +15,15 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    against the chip's bf16 peak (v5e: 197
                                    TFLOP/s; override BENCH_PEAK_TFLOPS)
   - lstm_train_tokens_per_sec      GravesLSTM char-RNN (BASELINE #3)
-  - lstm_plain_tokens_per_sec      plain (no-peephole) LSTM, same shapes
+  - lstm_plain_tokens_per_sec      plain (no-peephole) LSTM, same shapes —
+                                   rides the fused Pallas cell (ops/
+                                   pallas_lstm.py) when applicable
   - lstm_reference_tokens_per_sec  independent flax OptimizedLSTMCell char-RNN
   - lstm_vs_reference              plain / reference (apples-to-apples ratio)
+    All three LSTM rows use DEVICE-slope timing (_loop_slope_time): the
+    ~ms-scale per-call tunnel dispatch floor would otherwise swamp the
+    ~0.2ms step and compress any real ratio toward 1.0 (round-3 change;
+    r02 numbers were host-chained and transport-dominated).
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
                                    #4), gated on a measured loss decrease on a
                                    held probe batch (quality gate)
@@ -48,6 +54,50 @@ WARMUP = 3
 
 
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+
+def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
+    """True DEVICE time per training step, measured as the slope between two
+    fori_loop repetition counts inside single jitted calls.
+
+    Rationale: the axon chip sits behind a tunnel with ~100ms synchronous
+    round-trip and a multi-ms pipelined dispatch floor per distinct call —
+    host-chained step timing therefore reports the transport, not the chip,
+    for any step under a few ms (the LSTM char-RNN step is ~0.2-0.3ms of
+    real device work). Running n steps inside ONE call and differencing two
+    n values cancels every fixed per-call cost. Each timing call is salted
+    (a real input folded in at 1e-30 scale) so the transport cannot serve a
+    cached result for a repeated identical request. The n values are large
+    enough that the differenced device work (hundreds of ms) dominates the
+    tunnel's multi-ms call-time jitter.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x, state = args
+
+    def make(n):
+        @jax.jit
+        def many(salt, x, st):
+            xs = x + jnp.asarray(salt, x.dtype) * 1e-30
+            return jax.lax.fori_loop(0, n, lambda k, a: step_fn(xs, a), st)
+        return many
+
+    times = []
+    salt = 0.0
+    for n in n_pair:
+        f = make(n)
+        out = f(0.0, x, state)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(REPEATS):
+            salt += 1.0
+            t0 = time.perf_counter()
+            out = f(salt, x, state)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        times.append(best)
+    return (times[1] - times[0]) / (n_pair[1] - n_pair[0])
 
 
 def _time_steps(step_fn, args, steps):
@@ -229,18 +279,20 @@ def bench_lstm(cell: str = "graves"):
     x = jnp.asarray(np.eye(V, dtype=np.float32)[ids])
     y = jnp.asarray(np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
 
-    @functools.partial(jax.jit, donate_argnums=(0, 2))
-    def step(params, state, opt_state, it, key):
+    def step(xs, carry):
+        params, state, opt_state, it, key = carry
         def lf(p):
-            return net.loss_fn(p, state, x, y, train=True, rng=key)
+            return net.loss_fn(p, state, xs, y, train=True, rng=key)
         (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
         new_params, new_opt = net.updater.update(grads, opt_state, params, it)
         return new_params, new_state, new_opt, it + 1, key
 
-    args = [net.params, net.state, net.opt_state,
-            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)]
-    runner, flops = _aot(step, args)
-    dt = _time_steps(runner, args, STEPS)
+    carry = (net.params, net.state, net.opt_state,
+             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    _, flops = _aot(jax.jit(step), [x, carry])
+    # device-slope timing: the LSTM step is ~0.2ms of device work, far below
+    # the tunnel's per-call dispatch floor — see _loop_slope_time
+    dt = _loop_slope_time(step, (x, carry))
     return B * T / dt, flops
 
 
@@ -271,17 +323,18 @@ def bench_lstm_reference():
     tx = optax.rmsprop(1e-3)
     opt_state = tx.init(params)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state):
+    def step(xs, carry):
+        params, opt_state = carry
         def lf(p):
-            logits = model.apply(p, x)
+            logits = model.apply(p, xs)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
         loss, grads = jax.value_and_grad(lf)(params)
         updates, new_opt = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
-    dt = _time_steps(step, [params, opt_state], STEPS)
+    # same device-slope method as bench_lstm for an apples-to-apples ratio
+    dt = _loop_slope_time(step, (x, (params, opt_state)))
     return B * T / dt
 
 
@@ -492,7 +545,9 @@ def main():
     extras = {}
     # hard wall-clock budget: the driver must ALWAYS get the JSON line, so
     # extras are skipped (reported null) once the budget is spent
-    budget = float(os.environ.get("BENCH_BUDGET_S", "480"))
+    # slope-timed LSTM stages compile two loop programs each; 480s starved
+    # the tail extras (r3), hence the raised default
+    budget = float(os.environ.get("BENCH_BUDGET_S", "900"))
     t_start = time.perf_counter()
     if os.environ.get("BENCH_SKIP_EXTRAS", "0") != "1":
         for name, fn in [

@@ -1,8 +1,8 @@
 from .dataset import (AsyncDataSetIterator, DataSet, DataSetIterator,
                       ListDataSetIterator)
 from .fetchers import (Cifar10DataSetIterator, CurvesDataSetIterator,
-                       IrisDataSetIterator, load_cifar10, load_curves,
-                       load_iris)
+                       IrisDataSetIterator, LFWDataSetIterator,
+                       load_cifar10, load_curves, load_iris, load_lfw)
 from .iterators import (EarlyTerminationDataSetIterator,
                         ExistingDataSetIterator, IteratorDataSetIterator,
                         ListMultiDataSetIterator, MultiDataSet,
@@ -13,8 +13,9 @@ __all__ = [
     "AsyncDataSetIterator", "Cifar10DataSetIterator", "CurvesDataSetIterator",
     "DataSet", "DataSetIterator", "EarlyTerminationDataSetIterator",
     "ExistingDataSetIterator", "IrisDataSetIterator",
-    "IteratorDataSetIterator", "ListDataSetIterator",
+    "IteratorDataSetIterator", "LFWDataSetIterator",
+    "ListDataSetIterator",
     "ListMultiDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
     "MultipleEpochsIterator", "SamplingDataSetIterator", "load_cifar10",
-    "load_curves", "load_iris", "load_mnist",
+    "load_curves", "load_iris", "load_lfw", "load_mnist",
 ]

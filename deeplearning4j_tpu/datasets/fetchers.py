@@ -140,3 +140,101 @@ class CurvesDataSetIterator(ListDataSetIterator):
                  resolution: int = 28, seed: int = 12345):
         x, y = load_curves(num_examples, resolution, seed)
         super().__init__(features=x, labels=y, batch_size=batch_size)
+
+
+# ------------------------------------------------------------------------ LFW
+def _synthetic_lfw(n: int, n_people: int, h: int, w: int, seed: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-person base 'face' + per-image noise: identity-learnable,
+    deterministic, clearly synthetic (same stance as _synthetic_cifar)."""
+    rng = np.random.default_rng(seed)
+    yi = rng.integers(0, n_people, n)
+    base = rng.normal(0.5, 0.18, size=(n_people, h, w, 3))
+    x = base[yi] + rng.normal(0.0, 0.06, size=(n, h, w, 3))
+    x = np.clip(x, 0.0, 1.0).astype(np.float32)
+    return x, np.eye(n_people, dtype=np.float32)[yi]
+
+
+def load_lfw(cache_dir: str = DEFAULT_CACHE, *, height: int = 64,
+             width: int = 64, num_people: Optional[int] = None,
+             min_images_per_person: int = 2,
+             allow_synthetic_fallback: bool = True, n_synthetic: int = 256,
+             n_synthetic_people: int = 5
+             ) -> Tuple[np.ndarray, np.ndarray, list, bool]:
+    """Labeled Faces in the Wild (reference
+    datasets/fetchers/LFWDataFetcher.java: downloads+untars the lfw archive
+    of person-named jpg directories, labels = person identities, images
+    scaled to the requested dims).
+
+    Looks for the standard ``lfw/<person_name>/*.jpg`` tree (or ``lfw.tgz``)
+    under ``cache_dir`` — no network egress in this environment, so the
+    archive must be pre-placed; otherwise a deterministic synthetic fallback
+    keeps demos/tests running. Returns (x [N,h,w,3] float32 in [0,1],
+    one-hot labels, person_names, synthetic_flag). People are filtered to
+    those with >= ``min_images_per_person`` images (the reference's subset
+    behavior) and truncated to ``num_people`` most-photographed identities.
+    """
+    root = os.path.join(cache_dir, "lfw")
+    tgz = os.path.join(cache_dir, "lfw.tgz")
+    if not os.path.isdir(root) and os.path.exists(tgz):
+        with tarfile.open(tgz, "r:gz") as tf:
+            tf.extractall(cache_dir)  # noqa: S202 (local cache archive)
+    if os.path.isdir(root):
+        from PIL import Image
+        people = []
+        for name in sorted(os.listdir(root)):
+            pdir = os.path.join(root, name)
+            if not os.path.isdir(pdir):
+                continue
+            files = sorted(f for f in os.listdir(pdir)
+                           if f.lower().endswith((".jpg", ".jpeg", ".png")))
+            if len(files) >= min_images_per_person:
+                people.append((name, pdir, files))
+        people.sort(key=lambda t: -len(t[2]))
+        if num_people:
+            people = people[:num_people]
+        people.sort(key=lambda t: t[0])
+        if not people:
+            raise FileNotFoundError(
+                f"LFW tree at {root!r} has no identity with >= "
+                f"{min_images_per_person} images "
+                f"(min_images_per_person filter) — lower the threshold or "
+                f"check the directory layout (lfw/<person_name>/*.jpg)")
+        xs, yi, names = [], [], []
+        for label, (name, pdir, files) in enumerate(people):
+            names.append(name)
+            for f in files:
+                img = Image.open(os.path.join(pdir, f)).convert("RGB")
+                img = img.resize((width, height), Image.BILINEAR)
+                xs.append(np.asarray(img, np.float32) / 255.0)
+                yi.append(label)
+        x = np.stack(xs)
+        y = np.eye(len(people), dtype=np.float32)[np.asarray(yi)]
+        return x, y, names, False
+    if not allow_synthetic_fallback:
+        raise FileNotFoundError(
+            f"LFW not found under {cache_dir!r} and downloads are "
+            f"unavailable; place lfw.tgz there")
+    x, y = _synthetic_lfw(n_synthetic, n_synthetic_people, height, width,
+                          seed=23)
+    return x, y, [f"person_{i}" for i in range(n_synthetic_people)], True
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/LFWDataSetIterator.java."""
+
+    def __init__(self, batch_size: int = 32, *, height: int = 64,
+                 width: int = 64, num_people: Optional[int] = None,
+                 num_examples: Optional[int] = None,
+                 cache_dir: str = DEFAULT_CACHE,
+                 allow_synthetic_fallback: bool = True, shuffle: bool = True,
+                 seed: int = 12345):
+        x, y, self.people, self.synthetic = load_lfw(
+            cache_dir, height=height, width=width, num_people=num_people,
+            allow_synthetic_fallback=allow_synthetic_fallback)
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(x))
+            x, y = x[order], y[order]
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        super().__init__(features=x, labels=y, batch_size=batch_size)

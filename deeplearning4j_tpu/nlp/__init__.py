@@ -6,6 +6,8 @@ from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
 from .sequence_vectors import SequenceVectors
 from .serde import (read_binary_word_vectors, read_word_vectors,
                     write_binary_word_vectors, write_word_vectors)
+from .segmentation import (ChineseSegmenter, JapaneseSegmenter,
+                           LatticeSegmenter)
 from .tokenizer import (CJKTokenizerFactory, CommonPreprocessor,
                         DefaultTokenizerFactory, LowCasePreProcessor,
                         NGramTokenizerFactory, TokenizerFactory)

@@ -96,15 +96,19 @@ class NGramTokenizerFactory(TokenizerFactory):
 
 
 class CJKTokenizerFactory(TokenizerFactory):
-    """Language-pack seam for Chinese/Japanese/Korean text (reference
+    """Language pack for Chinese/Japanese/Korean text (reference
     deeplearning4j-nlp-{chinese,japanese,korean} vendor ansj/kuromoji
-    segmenters). Without a vendored segmenter, the robust zero-dependency
-    behavior is: contiguous Latin/digit runs stay whole words; CJK ideographs
-    are emitted as overlapping character bigrams (standard CJK IR fallback;
-    unigrams when ``bigrams=False``); hangul syllable runs stay whole
-    (Korean is space-delimited). A real segmenter can be plugged via
-    ``segmenter=`` (callable: str -> List[str]), which is the reference's
-    pluggable-tokenizer capability."""
+    segmenters).
+
+    ``language='zh'`` / ``'ja'`` selects the built-in dictionary + Viterbi
+    lattice segmenter (nlp/segmentation.py — the ansj/kuromoji mechanism)
+    as the DEFAULT. Any callable ``segmenter=`` (str -> List[str])
+    overrides it — the reference's pluggable-tokenizer capability. Without
+    either, the robust zero-dependency fallback applies: contiguous
+    Latin/digit runs stay whole words; CJK ideographs are emitted as
+    overlapping character bigrams (standard CJK IR fallback; unigrams when
+    ``bigrams=False``); hangul syllable runs stay whole (Korean is
+    space-delimited)."""
 
     _runs = re.compile(
         r"[A-Za-z0-9']+"                 # latin / digits
@@ -114,14 +118,31 @@ class CJKTokenizerFactory(TokenizerFactory):
     _cjk = re.compile(r"[一-鿿぀-ヿ]")
 
     def __init__(self, pre_processor: Optional[TokenPreProcessor] = None,
-                 bigrams: bool = True, segmenter: Optional[Callable] = None):
+                 bigrams: bool = True, segmenter: Optional[Callable] = None,
+                 language: Optional[str] = None):
         super().__init__(pre_processor)
         self.bigrams = bigrams
+        if segmenter is None and language is not None:
+            from .segmentation import ChineseSegmenter, JapaneseSegmenter
+            lang = language.lower()
+            if lang in ("zh", "chinese", "zh-cn"):
+                segmenter = ChineseSegmenter()
+            elif lang in ("ja", "japanese", "jp"):
+                segmenter = JapaneseSegmenter()
+            elif lang in ("ko", "korean"):
+                segmenter = None   # hangul runs are space-delimited; fallback
+            else:
+                raise ValueError(f"Unknown CJK language {language!r} "
+                                 f"(zh / ja / ko)")
         self.segmenter = segmenter
 
     def create(self, text: str) -> Tokenizer:
         if self.segmenter is not None:
-            toks = list(self.segmenter(text))
+            # drop pure punctuation/symbol tokens (。、！…) so they can't
+            # pollute the vocabulary — the fallback's run regex never emits
+            # them, and the reference segmenters tag them as punctuation
+            toks = [t for t in self.segmenter(text)
+                    if any(c.isalnum() for c in t)]
         else:
             toks = []
             for run in self._runs.findall(text):

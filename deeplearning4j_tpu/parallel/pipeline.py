@@ -8,7 +8,11 @@ extensions the survey directs to build on GSPMD/shard_map meshes).
 The practical pipeline case is a deep stack of IDENTICAL blocks (transformer
 / recurrent stacks): block parameters are STACKED on a leading stage axis and
 sharded over the ``pipe`` mesh axis, so each device holds 1/n of the
-parameters — the actual memory win of pipeline parallelism. Microbatches
+parameters — the actual memory win of pipeline parallelism. This identical-
+block restriction is by design: activations hop via ppermute (one static
+shape) and params stack on one leading axis; heterogeneous ends (embedding,
+LM head) stay outside the pipeline, replicated — see
+examples/pipeline_transformer.py for the end-to-end pattern. Microbatches
 stream through the classic GPipe schedule: at tick t, stage s processes
 microbatch (t - s); activations hop stage-to-stage via ``ppermute`` (ICI
 neighbor traffic) inside one ``lax.scan``. Forward is differentiable (scan +

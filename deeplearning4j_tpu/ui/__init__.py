@@ -4,9 +4,15 @@ from .dashboard import TrainingUIServer, render_dashboard, render_dashboard_html
 from .stats import StatsListener, StatsUpdateConfiguration
 from .storage import (FileStatsStorage, InMemoryStatsStorage, StatsStorage,
                       StatsStorageEvent)
+from .visual import (ConvolutionalIterationListener, activation_grid_png,
+                     render_model_graph, render_model_graph_svg,
+                     render_tsne, render_tsne_page)
 
 __all__ = [
     "StatsListener", "StatsUpdateConfiguration", "StatsStorage",
     "InMemoryStatsStorage", "FileStatsStorage", "StatsStorageEvent",
     "render_dashboard", "render_dashboard_html", "TrainingUIServer",
+    "ConvolutionalIterationListener", "activation_grid_png",
+    "render_model_graph", "render_model_graph_svg", "render_tsne",
+    "render_tsne_page",
 ]

@@ -39,6 +39,8 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>Mean magnitudes: parameters</h2>{param_chart}</div>
 <div class="card"><h2>Update : parameter ratio (log10)</h2>{ratio_chart}</div>
 {hist_cards}
+{activation_cards}
+{graph_card}
 <script type="application/json" id="stats-data">{data_json}</script>
 </body></html>
 """
@@ -171,6 +173,38 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
                       f"(iteration {last_with_hist['iteration']})</h2>"
                       + "".join(cells) + "</div>")
 
+    # conv-activation image grids (reference ConvolutionalIterationListener;
+    # posted by ui/visual.ConvolutionalIterationListener as base64 PNGs)
+    activation_cards = ""
+    last_with_acts = next((u for u in reversed(updates)
+                           if u.get("conv_activations")), None)
+    if last_with_acts:
+        cells = "".join(
+            f"<div style='display:inline-block;margin:6px;vertical-align:top'>"
+            f"<div class='meta'>{html.escape(str(n))}</div>"
+            f"<img src='data:image/png;base64,{b64}' "
+            f"style='image-rendering:pixelated;border:1px solid #ddd'/></div>"
+            for n, b64 in last_with_acts["conv_activations"].items())
+        activation_cards = (
+            "<div class='card'><h2>Convolutional activations (iteration "
+            f"{last_with_acts['iteration']})</h2>{cells}</div>")
+
+    # model-graph view (reference FlowIterationListener / TrainModule model
+    # tab) — rendered from the config JSON the StatsListener posts
+    graph_card = ""
+    cfg_json = static.get("model_config_json")
+    if cfg_json:
+        try:
+            from ..nn.conf import serde
+            from .visual import render_model_graph_svg
+            svg = render_model_graph_svg(serde.from_json(cfg_json))
+            graph_card = ("<div class='card'><h2>Model graph</h2>"
+                          f"<div style='overflow-x:auto'>{svg}</div></div>")
+        except (KeyError, ValueError, TypeError) as e:
+            graph_card = (f"<div class='card'><h2>Model graph</h2>"
+                          f"<p class='meta'>unrenderable: "
+                          f"{html.escape(str(e))}</p></div>")
+
     refresh = (f'<meta http-equiv="refresh" content="{auto_refresh_sec}">'
                if auto_refresh_sec else "")
     return _PAGE.format(
@@ -182,6 +216,8 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
         param_chart=_svg_line_chart(param_series),
         ratio_chart=_svg_line_chart(ratio_series),
         hist_cards=hist_cards,
+        activation_cards=activation_cards,
+        graph_card=graph_card,
         data_json=json.dumps({"session": session_id, "worker": worker_id,
                               "n_updates": len(updates)}),
     )

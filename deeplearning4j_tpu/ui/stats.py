@@ -216,6 +216,11 @@ class StatsListener(TrainingListener):
             "start_time": time.time(),
             "param_names": [n for n, _ in _named_leaves(model.params)],
         }
+        # config JSON powers the dashboard's model-graph view (reference
+        # TrainModule model tab renders from the stored config)
+        conf = getattr(model, "conf", None)
+        if conf is not None and hasattr(conf, "to_json"):
+            info["model_config_json"] = conf.to_json()
         self.storage.put_static_info(self.session_id, self.worker_id, info)
         self._static_posted = True
 

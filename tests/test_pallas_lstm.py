@@ -1,0 +1,138 @@
+"""Fused Pallas LSTM kernel parity tests (the XLA-vs-reference-path parity
+discipline of the reference's cuDNN helper tests, CuDNNGradientChecks.java —
+here: pallas fused path vs the lax.scan fallback, run in the pallas
+interpreter on the CPU test platform)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.pallas_lstm import (fused_lstm,
+                                                fused_lstm_applicable)
+
+R = np.random.default_rng(42)
+
+
+def _scan_ref(xp, h0, c0, Rm):
+    H = h0.shape[-1]
+
+    def step(carry, x):
+        h_prev, c_prev = carry
+        gates = x + h_prev @ Rm
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H])
+        o = jax.nn.sigmoid(gates[:, 2 * H:3 * H])
+        g = jnp.tanh(gates[:, 3 * H:])
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0), xp)
+    return hs, (hT, cT)
+
+
+def _inputs(T=6, B=8, H=128):
+    xp = jnp.asarray(R.normal(size=(T, B, 4 * H)).astype(np.float32) * 0.3)
+    h0 = jnp.asarray(R.normal(size=(B, H)).astype(np.float32) * 0.1)
+    c0 = jnp.asarray(R.normal(size=(B, H)).astype(np.float32) * 0.1)
+    Rm = jnp.asarray(R.normal(size=(H, 4 * H)).astype(np.float32) * 0.1)
+    return xp, h0, c0, Rm
+
+
+def test_applicability_gate():
+    f32 = jnp.float32
+    ok = dict(peepholes=None, mask=None, reverse=False, activation="tanh",
+              gate_activation="sigmoid")
+    assert fused_lstm_applicable(8, 128, f32, **ok)
+    assert not fused_lstm_applicable(8, 100, f32, **ok)        # H % 128
+    assert not fused_lstm_applicable(7, 128, f32, **ok)        # B % 8
+    assert not fused_lstm_applicable(8, 1024, f32, **ok)       # VMEM budget
+    assert not fused_lstm_applicable(8, 128, jnp.bfloat16, **ok)
+    assert not fused_lstm_applicable(
+        8, 128, f32, peepholes=(1, 2, 3), mask=None, reverse=False,
+        activation="tanh", gate_activation="sigmoid")          # Graves
+    assert not fused_lstm_applicable(
+        8, 128, f32, peepholes=None, mask=None, reverse=False,
+        activation="relu", gate_activation="sigmoid")
+
+
+def test_forward_matches_scan():
+    xp, h0, c0, Rm = _inputs()
+    hs1, (hT1, cT1) = fused_lstm(xp, h0, c0, Rm)
+    hs2, (hT2, cT2) = _scan_ref(xp, h0, c0, Rm)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT1), np.asarray(hT2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT1), np.asarray(cT2), atol=1e-6)
+
+
+def test_backward_matches_scan_autodiff():
+    """custom_vjp gradients (incl. final-state cotangents) vs jax.grad of the
+    scan — every input gets a nontrivial cotangent."""
+    xp, h0, c0, Rm = _inputs()
+    w = jnp.asarray(R.normal(size=(6, 8, 128)).astype(np.float32))
+
+    def loss(f):
+        def lf(xp, h0, c0, Rm):
+            hs, (hT, cT) = f(xp, h0, c0, Rm)
+            return (jnp.sum(hs * w) + jnp.sum(jnp.tanh(hT) * 0.3)
+                    + jnp.sum(cT * cT) * 0.1)
+        return lf
+
+    g1 = jax.grad(loss(fused_lstm), argnums=(0, 1, 2, 3))(xp, h0, c0, Rm)
+    g2 = jax.grad(loss(_scan_ref), argnums=(0, 1, 2, 3))(xp, h0, c0, Rm)
+    for name, a, b in zip(("dx_proj", "dh0", "dc0", "dR"), g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
+                                   err_msg=name)
+
+
+def test_layer_training_identical_with_and_without_fused(monkeypatch):
+    """A whole MLN training step is bitwise-insensitive to which LSTM path
+    ran (f32 tolerance): loss and updated params agree."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1),
+                                       dtype="float32")
+                .list(LSTM(n_out=128, activation="tanh"),
+                      RnnOutputLayer(n_out=5, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(5, 6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    x = R.normal(size=(8, 6, 5)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[R.integers(0, 5, (8, 6))]
+
+    results = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        net = build()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=3, batch_size=8)
+        results[flag] = (s0, net.score(x, y), np.asarray(net.params_flat()))
+    assert np.isclose(results["1"][0], results["0"][0], atol=1e-5)
+    assert np.isclose(results["1"][1], results["0"][1], atol=1e-5)
+    np.testing.assert_allclose(results["1"][2], results["0"][2], atol=1e-4)
+    assert results["1"][1] < results["1"][0]  # actually trained
+
+
+def test_rnn_time_step_consistent_with_fused(monkeypatch):
+    """apply_with_final_state (the tBPTT / streaming carry) returns the same
+    final state on both paths."""
+    from deeplearning4j_tpu.nn.layers import LSTM
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    layer = LSTM(n_in=5, n_out=128, activation="tanh")
+    params, state = layer.init(jax.random.PRNGKey(0),
+                               InputType.recurrent(5, 6), jnp.float32)
+    x = jnp.asarray(R.normal(size=(8, 6, 5)).astype(np.float32))
+    outs = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        hs, (hT, cT) = layer.apply_with_final_state(params, state, x)
+        outs[flag] = (np.asarray(hs), np.asarray(hT), np.asarray(cT))
+    for a, b in zip(outs["1"], outs["0"]):
+        np.testing.assert_allclose(a, b, atol=1e-6)

@@ -43,15 +43,19 @@ def _lstm_scan(x_proj, h0, c0, R, act, gate_act, peepholes=None, mask=None,
     The fused path is the reference's accelerated-helper seam
     (ConvolutionLayer.java:72 reflection probe for cuDNN) done the TPU way:
     ops/pallas_lstm.py pins the recurrent matrix in VMEM across the whole
-    time loop; measured 2.4x device-time vs this scan at the char-RNN bench
-    shape (2-layer net, T=64, B=32, H=512).
+    time loop; measured 2.4-2.7x device-time vs this scan and 3.0x vs the
+    flax OptimizedLSTMCell reference at the char-RNN bench shape (2-layer
+    net, T=64, B=32, H=512) — numbers in ops/pallas_lstm.py.
     """
     H = h0.shape[-1]
-    from ...ops.pallas_lstm import fused_lstm, fused_lstm_applicable
+    from ...ops.pallas_lstm import (fused_lstm, fused_lstm_applicable,
+                                    fused_lstm_peephole)
     if fused_lstm_applicable(h0.shape[0], H, x_proj.dtype,
                              peepholes=peepholes, mask=mask, reverse=reverse,
                              activation=activation_names[0],
                              gate_activation=activation_names[1]):
+        if peepholes is not None:
+            return fused_lstm_peephole(x_proj, h0, c0, R, *peepholes)
         return fused_lstm(x_proj, h0, c0, R)
 
     def step(carry, inp):

@@ -1,10 +1,11 @@
-"""Fused LSTM time-loop kernels (Pallas / TPU).
+"""Fused LSTM time-loop kernels (Pallas / TPU) — plain and Graves
+(peephole) variants.
 
 Reference hot loop: nn/layers/recurrent/LSTMHelpers.java:184-207 (fwd gemm
-per timestep), :466 (bwd loop). The ``lax.scan`` path re-reads the [H, 4H]
-recurrent matrix R from HBM on every timestep — T * 16*H^2 bytes of
-redundant traffic that leaves the cell bandwidth-bound at ~2% MFU
-(BENCH mfu.lstm_plain). These kernels pin R (forward) and R plus the dR
+per timestep, incl. the peephole terms) and :466 (bwd loop). The
+``lax.scan`` path re-reads the [H, 4H] recurrent matrix R from HBM on every
+timestep — T * 16*H^2 bytes of redundant traffic that leaves the cell
+bandwidth-bound at ~2% MFU. These kernels pin R (forward) and R plus the dR
 accumulator (backward) in VMEM across the whole time loop: the TPU grid is
 sequential on a core, so VMEM scratch and constant-index output blocks
 persist between grid steps, turning the recurrence into a VMEM-resident
@@ -15,14 +16,16 @@ pin one to the other (tests/test_pallas_lstm.py).
 
 Measured on v5e (device-slope timing, bench.py _loop_slope_time) at the
 char-RNN bench shape (2-layer net, T=64, B=32, H=512, f32): single-layer
-train step 164us fused vs 297us scan; full-net 3.97M tokens/s fused vs
-1.66M scan (2.4x) vs 1.27M flax OptimizedLSTMCell (3.1x).
+train step 164us fused vs 297us scan; full-net 4.0M tokens/s fused vs
+1.33M flax OptimizedLSTMCell (3.0x).
 
-Supported fast path: plain LSTM (no peepholes), tanh/sigmoid activations,
-no mask, float32, H % 128 == 0, B % 8 == 0, VMEM-resident R (H <= 512).
-Everything else falls back to the scan in nn/layers/recurrent.py.
+Supported fast path: tanh/sigmoid activations, no mask, float32,
+H % 128 == 0, B % 8 == 0, VMEM-resident R (H <= 512); with or without
+peephole connections (GravesLSTM). Everything else falls back to the scan
+in nn/layers/recurrent.py.
 
 Gate order along the 4H axis matches the scan path: [i, f, o, g].
+Peepholes follow LSTMHelpers.java: i/f gates peep at c_{t-1}, o at c_t.
 """
 from __future__ import annotations
 
@@ -48,12 +51,14 @@ _MAX_FUSED_H = 512
 def fused_lstm_applicable(B: int, H: int, dtype, *, peepholes, mask,
                           reverse: bool, activation: str,
                           gate_activation: str) -> bool:
-    """Can the fused kernel handle this call? (the helper-probe predicate)"""
+    """Can the fused kernel handle this call? (the helper-probe predicate).
+    ``peepholes`` may be None (plain LSTM) or the (pi, pf, po) tuple
+    (GravesLSTM) — both are supported."""
     if not PALLAS_AVAILABLE:
         return False
     if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
         return False
-    if peepholes is not None or mask is not None or reverse:
+    if mask is not None or reverse:
         return False
     if activation != "tanh" or gate_activation != "sigmoid":
         return False
@@ -72,9 +77,12 @@ def _interpret() -> bool:
 
 
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(x_ref, r_ref, h0_ref, c0_ref,
-                hs_ref, gates_ref, cs_ref, cprev_ref, hprev_ref,
-                hT_ref, cT_ref, h_scr, c_scr):
+def _fwd_body(peephole, x_ref, r_ref, h0_ref, c0_ref, *rest):
+    if peephole:
+        pi_ref, pf_ref, po_ref = rest[:3]
+        rest = rest[3:]
+    (hs_ref, gates_ref, cs_ref, cprev_ref, hprev_ref,
+     hT_ref, cT_ref, h_scr, c_scr) = rest
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -87,11 +95,18 @@ def _fwd_kernel(x_ref, r_ref, h0_ref, c0_ref,
     H = h_prev.shape[-1]
     gates = x_ref[0] + jnp.dot(h_prev, r_ref[:],
                                preferred_element_type=jnp.float32)
-    i = jax.nn.sigmoid(gates[:, :H])
-    f = jax.nn.sigmoid(gates[:, H:2 * H])
-    o = jax.nn.sigmoid(gates[:, 2 * H:3 * H])
-    g = jnp.tanh(gates[:, 3 * H:])
+    zi, zf = gates[:, :H], gates[:, H:2 * H]
+    zo, zg = gates[:, 2 * H:3 * H], gates[:, 3 * H:]
+    if peephole:  # LSTMHelpers.java: i/f peep at c_{t-1}
+        zi = zi + c_prev * pi_ref[0]
+        zf = zf + c_prev * pf_ref[0]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
     c = f * c_prev + i * g
+    if peephole:  # o peeps at c_t
+        zo = zo + c * po_ref[0]
+    o = jax.nn.sigmoid(zo)
     h = o * jnp.tanh(c)
     hs_ref[0] = h
     # post-activation gates + prev-state views are the backward residuals;
@@ -106,7 +121,7 @@ def _fwd_kernel(x_ref, r_ref, h0_ref, c0_ref,
     c_scr[:] = c
 
 
-def _fwd_call(x_proj, h0, c0, R):
+def _fwd_call(x_proj, h0, c0, R, peep=None):
     T, B, H4 = x_proj.shape
     H = H4 // 4
     f32 = jnp.float32
@@ -124,22 +139,35 @@ def _fwd_call(x_proj, h0, c0, R):
     full = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     const = lambda: pl.BlockSpec((B, H), lambda t: (0, 0),
                                  memory_space=pltpu.VMEM)
+    peep_spec = lambda: pl.BlockSpec((1, H), lambda t: (0, 0),
+                                     memory_space=pltpu.VMEM)
+    in_specs = [step_block(H4), full(), const(), const()]
+    args = [x_proj, R, h0, c0]
+    if peep is not None:
+        in_specs += [peep_spec()] * 3
+        args += [p.reshape(1, H) for p in peep]
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_body, peep is not None),
         grid=(T,),
-        in_specs=[step_block(H4), full(), const(), const()],
+        in_specs=in_specs,
         out_specs=[step_block(H), step_block(H4), step_block(H),
                    step_block(H), step_block(H), const(), const()],
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)],
         interpret=_interpret(),
-    )(x_proj, R, h0, c0)
+    )(*args)
 
 
 # ----------------------------------------------------------------- backward
-def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
-                r_ref, dhT_ref, dcT_ref,
-                dxp_ref, dh0_ref, dc0_ref, dR_ref, dh_scr, dc_scr):
+def _bwd_body(peephole, gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
+              r_ref, dhT_ref, dcT_ref, *rest):
+    if peephole:
+        pi_ref, pf_ref, po_ref = rest[:3]
+        rest = rest[3:]
+        (dxp_ref, dh0_ref, dc0_ref, dR_ref, dpi_ref, dpf_ref, dpo_ref,
+         dh_scr, dc_scr) = rest
+    else:
+        dxp_ref, dh0_ref, dc0_ref, dR_ref, dh_scr, dc_scr = rest
     r = pl.program_id(0)
 
     @pl.when(r == 0)
@@ -147,6 +175,10 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
         dh_scr[:] = dhT_ref[:]
         dc_scr[:] = dcT_ref[:]
         dR_ref[:] = jnp.zeros_like(dR_ref)
+        if peephole:
+            dpi_ref[:] = jnp.zeros_like(dpi_ref)
+            dpf_ref[:] = jnp.zeros_like(dpf_ref)
+            dpo_ref[:] = jnp.zeros_like(dpo_ref)
 
     gates = gates_ref[0]
     H = cs_ref.shape[-1]
@@ -158,10 +190,12 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
     tc = jnp.tanh(c)
     dh = dh_scr[:] + dhs_ref[0]
     do = dh * tc
+    dzo = do * o * (1.0 - o)
     dc = dc_scr[:] + dh * o * (1.0 - tc * tc)
+    if peephole:  # zo = ... + c * po, so dc picks up dzo * po
+        dc = dc + dzo * po_ref[0]
     dzi = dc * g * i * (1.0 - i)
     dzf = dc * c_prev * f * (1.0 - f)
-    dzo = do * o * (1.0 - o)
     dzg = dc * i * (1.0 - g * g)
     dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)    # [B, 4H]
     dxp_ref[0] = dz
@@ -169,9 +203,15 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
     # which stays VMEM-resident across the sequential grid
     dR_ref[:] += lax.dot_general(h_prev, dz, (((0,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+    new_dc = dc * f
+    if peephole:
+        dpi_ref[:] += jnp.sum(dzi * c_prev, axis=0)[None, :]
+        dpf_ref[:] += jnp.sum(dzf * c_prev, axis=0)[None, :]
+        dpo_ref[:] += jnp.sum(dzo * c, axis=0)[None, :]
+        # zi/zf peep at c_{t-1}: their grads flow into dc_prev
+        new_dc = new_dc + dzi * pi_ref[0] + dzf * pf_ref[0]
     new_dh = lax.dot_general(dz, r_ref[:], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    new_dc = dc * f
     dh_scr[:] = new_dh
     dc_scr[:] = new_dc
     # after the final (t==0) step these hold the initial-state cotangents
@@ -179,7 +219,7 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, dhs_ref,
     dc0_ref[:] = new_dc
 
 
-def _bwd_call(gates, cs, c_prev, h_prev, dhs, R, dhT, dcT):
+def _bwd_call(gates, cs, c_prev, h_prev, dhs, R, dhT, dcT, peep=None):
     T, B, H4 = gates.shape
     H = H4 // 4
     f32 = jnp.float32
@@ -188,31 +228,41 @@ def _bwd_call(gates, cs, c_prev, h_prev, dhs, R, dhT, dcT):
     full = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     const = lambda: pl.BlockSpec((B, H), lambda r: (0, 0),
                                  memory_space=pltpu.VMEM)
+    peep_spec = lambda: pl.BlockSpec((1, H), lambda r: (0, 0),
+                                     memory_space=pltpu.VMEM)
     out_shape = [
         jax.ShapeDtypeStruct((T, B, H4), f32),   # dx_proj
         jax.ShapeDtypeStruct((B, H), f32),       # dh0
         jax.ShapeDtypeStruct((B, H), f32),       # dc0
         jax.ShapeDtypeStruct((H, H4), f32),      # dR
     ]
+    out_specs = [rev(H4), const(), const(),
+                 pl.BlockSpec((H, H4), lambda r: (0, 0),
+                              memory_space=pltpu.VMEM)]
+    in_specs = [rev(H4), rev(H), rev(H), rev(H), rev(H), full(),
+                const(), const()]
+    args = [gates, cs, c_prev, h_prev, dhs, R, dhT, dcT]
+    if peep is not None:
+        in_specs += [peep_spec()] * 3
+        args += [p.reshape(1, H) for p in peep]
+        out_shape += [jax.ShapeDtypeStruct((1, H), f32)] * 3  # dpi dpf dpo
+        out_specs += [peep_spec()] * 3
     return pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_body, peep is not None),
         grid=(T,),
-        in_specs=[rev(H4), rev(H), rev(H), rev(H), rev(H), full(),
-                  const(), const()],
-        out_specs=[rev(H4), const(), const(),
-                   pl.BlockSpec((H, H4), lambda r: (0, 0),
-                                memory_space=pltpu.VMEM)],
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)],
         interpret=_interpret(),
-    )(gates, cs, c_prev, h_prev, dhs, R, dhT, dcT)
+    )(*args)
 
 
 # -------------------------------------------------------------- custom VJP
 @jax.custom_vjp
 def fused_lstm(x_proj, h0, c0, R):
-    """Run the fused LSTM over time. x_proj: [T, B, 4H] precomputed input
-    projections (+bias); returns (hs [T, B, H], (hT, cT))."""
+    """Run the fused plain LSTM over time. x_proj: [T, B, 4H] precomputed
+    input projections (+bias); returns (hs [T, B, H], (hT, cT))."""
     hs, _, _, _, _, hT, cT = _fwd_call(x_proj, h0, c0, R)
     return hs, (hT, cT)
 
@@ -230,3 +280,29 @@ def _fused_lstm_bwd(res, cts):
 
 
 fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
+
+
+@jax.custom_vjp
+def fused_lstm_peephole(x_proj, h0, c0, R, pi, pf, po):
+    """Fused GravesLSTM (peephole) variant — reference GravesLSTM.java:47 /
+    LSTMHelpers peephole terms. pi/pf/po: [H]."""
+    hs, *_, hT, cT = _fwd_call(x_proj, h0, c0, R, (pi, pf, po))
+    return hs, (hT, cT)
+
+
+def _fused_lstm_peep_fwd(x_proj, h0, c0, R, pi, pf, po):
+    hs, gates, cs, c_prev, h_prev, hT, cT = _fwd_call(x_proj, h0, c0, R,
+                                                      (pi, pf, po))
+    return (hs, (hT, cT)), (gates, cs, c_prev, h_prev, R, pi, pf, po)
+
+
+def _fused_lstm_peep_bwd(res, cts):
+    gates, cs, c_prev, h_prev, R, pi, pf, po = res
+    dhs, (dhT, dcT) = cts
+    dxp, dh0, dc0, dR, dpi, dpf, dpo = _bwd_call(
+        gates, cs, c_prev, h_prev, dhs, R, dhT, dcT, (pi, pf, po))
+    return (dxp, dh0, dc0, dR, dpi.reshape(-1), dpf.reshape(-1),
+            dpo.reshape(-1))
+
+
+fused_lstm_peephole.defvjp(_fused_lstm_peep_fwd, _fused_lstm_peep_bwd)

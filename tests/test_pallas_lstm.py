@@ -49,9 +49,9 @@ def test_applicability_gate():
     assert not fused_lstm_applicable(7, 128, f32, **ok)        # B % 8
     assert not fused_lstm_applicable(8, 1024, f32, **ok)       # VMEM budget
     assert not fused_lstm_applicable(8, 128, jnp.bfloat16, **ok)
-    assert not fused_lstm_applicable(
+    assert fused_lstm_applicable(
         8, 128, f32, peepholes=(1, 2, 3), mask=None, reverse=False,
-        activation="tanh", gate_activation="sigmoid")          # Graves
+        activation="tanh", gate_activation="sigmoid")          # Graves: yes
     assert not fused_lstm_applicable(
         8, 128, f32, peepholes=None, mask=None, reverse=False,
         activation="relu", gate_activation="sigmoid")
@@ -136,3 +136,84 @@ def test_rnn_time_step_consistent_with_fused(monkeypatch):
         outs[flag] = (np.asarray(hs), np.asarray(hT), np.asarray(cT))
     for a, b in zip(outs["1"], outs["0"]):
         np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def _scan_peep_ref(xp, h0, c0, Rm, pi, pf, po):
+    H = h0.shape[-1]
+
+    def step(carry, x):
+        h_prev, c_prev = carry
+        gates = x + h_prev @ Rm
+        zi = gates[:, :H] + c_prev * pi
+        zf = gates[:, H:2 * H] + c_prev * pf
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf)
+        g = jnp.tanh(gates[:, 3 * H:])
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(gates[:, 2 * H:3 * H] + c * po)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0), xp)
+    return hs, (hT, cT)
+
+
+def test_peephole_forward_and_backward_match_scan():
+    """Graves (peephole) fused kernel parity vs the scan, fwd + all grads
+    incl. dpi/dpf/dpo (reference LSTMHelpers peephole terms)."""
+    from deeplearning4j_tpu.ops.pallas_lstm import fused_lstm_peephole
+    xp, h0, c0, Rm = _inputs()
+    pi = jnp.asarray(R.normal(size=(128,)).astype(np.float32) * 0.2)
+    pf = jnp.asarray(R.normal(size=(128,)).astype(np.float32) * 0.2)
+    po = jnp.asarray(R.normal(size=(128,)).astype(np.float32) * 0.2)
+
+    hs1, (hT1, cT1) = fused_lstm_peephole(xp, h0, c0, Rm, pi, pf, po)
+    hs2, (hT2, cT2) = _scan_peep_ref(xp, h0, c0, Rm, pi, pf, po)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cT1), np.asarray(cT2), atol=1e-6)
+
+    w = jnp.asarray(R.normal(size=hs2.shape).astype(np.float32))
+
+    def loss(f):
+        def lf(*args):
+            hs, (hT, cT) = f(*args)
+            return (jnp.sum(hs * w) + jnp.sum(jnp.tanh(hT) * 0.3)
+                    + jnp.sum(cT * cT) * 0.1)
+        return lf
+
+    argnums = tuple(range(7))
+    g1 = jax.grad(loss(fused_lstm_peephole), argnums=argnums)(
+        xp, h0, c0, Rm, pi, pf, po)
+    g2 = jax.grad(loss(_scan_peep_ref), argnums=argnums)(
+        xp, h0, c0, Rm, pi, pf, po)
+    for name, a, b in zip(("dxp", "dh0", "dc0", "dR", "dpi", "dpf", "dpo"),
+                          g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5,
+                                   err_msg=name)
+
+
+def test_graves_layer_training_identical_with_and_without_fused(monkeypatch):
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1),
+                                       dtype="float32")
+                .list(GravesLSTM(n_out=128, activation="tanh"),
+                      RnnOutputLayer(n_out=5, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(5, 6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    x = R.normal(size=(8, 6, 5)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[R.integers(0, 5, (8, 6))]
+    results = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        net = build()
+        net.fit(x, y, epochs=3, batch_size=8)
+        results[flag] = (net.score(x, y), np.asarray(net.params_flat()))
+    assert np.isclose(results["1"][0], results["0"][0], atol=1e-5)
+    np.testing.assert_allclose(results["1"][1], results["0"][1], atol=1e-4)

@@ -179,3 +179,86 @@ def test_sklearn_style_wrappers():
              .build())
     reg = NeuralNetRegressor(rconf, epochs=40, batch_size=64).fit(x, yr)
     assert reg.score(x, yr) > 0.8
+
+
+# ------------------------------------------------------ streaming route (r3)
+def test_streaming_ingest_trains_live():
+    """CamelKafkaRouteBuilder analogue (reference dl4j-streaming): a
+    producer thread POSTs minibatches over HTTP while net.fit consumes the
+    live topic; training sees every published batch and improves."""
+    import json
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.parallel.streaming import (StreamingDataSetIterator,
+                                                       StreamingIngestServer)
+
+    conf = (NeuralNetConfiguration(seed=1, updater=Adam(5e-3), dtype="float32")
+            .list(DenseLayer(n_in=6, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X.sum(-1) > 0).astype(int)]
+    s0 = net.score(X, Y)
+
+    topic = StreamingDataSetIterator(capacity=8)
+    srv = StreamingIngestServer(topic).start()
+    url = f"http://127.0.0.1:{srv.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(url + path,
+                                     json.dumps(payload).encode(),
+                                     {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def producer():
+        for s in range(0, 256, 32):
+            post("/publish", {"features": X[s:s + 32].tolist(),
+                              "labels": Y[s:s + 32].tolist()})
+        post("/end", {})
+
+    t = threading.Thread(target=producer)
+    t.start()
+    net.fit(iterator=topic, epochs=1)    # blocks on the live stream
+    t.join()
+    stats = json.loads(urllib.request.urlopen(url + "/stats").read())
+    srv.stop()
+    assert stats["published"] == 8 and stats["consumed"] == 8
+    assert stats["closed"]
+    assert net.score(X, Y) < s0
+
+
+def test_streaming_topic_backpressure_and_timeout():
+    from deeplearning4j_tpu.parallel.streaming import StreamingDataSetIterator
+    topic = StreamingDataSetIterator(capacity=2, timeout=0.2)
+    x = np.zeros((4, 3), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    assert topic.publish(x, y, block=False)
+    assert topic.publish(x, y, block=False)
+    assert not topic.publish(x, y, block=False)   # full -> back-pressure
+    seen = sum(1 for _ in topic)                  # drains 2, then idle timeout
+    assert seen == 2
+    topic.end_of_stream()
+    assert not topic.publish(x, y)                # closed
+
+
+def test_streaming_close_never_hangs_on_full_topic():
+    """end_of_stream on a FULL topic returns immediately and queued batches
+    still drain (the close is an event, not a sentinel slot)."""
+    import time
+    from deeplearning4j_tpu.parallel.streaming import StreamingDataSetIterator
+    topic = StreamingDataSetIterator(capacity=2)
+    x = np.zeros((1, 2), np.float32)
+    y = np.zeros((1, 2), np.float32)
+    assert topic.publish(x, y, block=False)
+    assert topic.publish(x, y, block=False)   # full
+    t0 = time.perf_counter()
+    topic.end_of_stream()                      # must not block
+    assert time.perf_counter() - t0 < 0.5
+    assert sum(1 for _ in topic) == 2          # accepted batches all consumed

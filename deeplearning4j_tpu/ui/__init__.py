@@ -2,7 +2,8 @@
 deeplearning4j-ui-parent)."""
 from .dashboard import TrainingUIServer, render_dashboard, render_dashboard_html
 from .stats import StatsListener, StatsUpdateConfiguration
-from .storage import (FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+from .storage import (FileStatsStorage, InMemoryStatsStorage,
+                      SqliteStatsStorage, StatsStorage,
                       StatsStorageEvent)
 from .visual import (ConvolutionalIterationListener, activation_grid_png,
                      render_model_graph, render_model_graph_svg,
@@ -10,7 +11,8 @@ from .visual import (ConvolutionalIterationListener, activation_grid_png,
 
 __all__ = [
     "StatsListener", "StatsUpdateConfiguration", "StatsStorage",
-    "InMemoryStatsStorage", "FileStatsStorage", "StatsStorageEvent",
+    "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+    "StatsStorageEvent",
     "render_dashboard", "render_dashboard_html", "TrainingUIServer",
     "ConvolutionalIterationListener", "activation_grid_png",
     "render_model_graph", "render_model_graph_svg", "render_tsne",

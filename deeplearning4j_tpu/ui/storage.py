@@ -198,6 +198,91 @@ class FileStatsStorage(StatsStorage):
         return [u for u in out if u.get("iteration", 0) > since_iteration]
 
 
+class SqliteStatsStorage(StatsStorage):
+    """Indexed SQLite backend (reference ui/storage/sqlite/
+    J7FileStatsStorage / the sqlite storage module): durable, queryable by
+    (session, worker, iteration) with an index, safe for a separate
+    dashboard process to read while a training run writes (WAL mode).
+    Records are stored as JSON text — same dict records as every other
+    backend."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        import sqlite3
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS static_info ("
+                " session TEXT NOT NULL, worker TEXT NOT NULL,"
+                " data TEXT NOT NULL, PRIMARY KEY (session, worker))")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS updates ("
+                " session TEXT NOT NULL, worker TEXT NOT NULL,"
+                " iteration INTEGER NOT NULL, data TEXT NOT NULL)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_updates"
+                " ON updates (session, worker, iteration)")
+            self._conn.commit()
+
+    def put_static_info(self, session_id, worker_id, info):
+        with self._lock:
+            known = session_id in self.list_session_ids()
+            self._conn.execute(
+                "INSERT OR REPLACE INTO static_info VALUES (?, ?, ?)",
+                (session_id, worker_id, json.dumps(info)))
+            self._conn.commit()
+        if not known:
+            self._notify(StatsStorageEvent.NEW_SESSION, session_id, worker_id)
+        self._notify(StatsStorageEvent.POST_STATIC, session_id, worker_id)
+
+    def put_update(self, session_id, worker_id, update):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO updates VALUES (?, ?, ?, ?)",
+                (session_id, worker_id, int(update.get("iteration", 0)),
+                 json.dumps(update)))
+            self._conn.commit()
+        self._notify(StatsStorageEvent.POST_UPDATE, session_id, worker_id)
+
+    def list_session_ids(self):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT session FROM static_info "
+                "UNION SELECT DISTINCT session FROM updates").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def list_worker_ids(self, session_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT worker FROM static_info WHERE session=? "
+                "UNION SELECT DISTINCT worker FROM updates WHERE session=?",
+                (session_id, session_id)).fetchall()
+        return sorted(r[0] for r in rows)
+
+    def get_static_info(self, session_id, worker_id):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM static_info WHERE session=? AND worker=?",
+                (session_id, worker_id)).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def get_updates(self, session_id, worker_id, since_iteration=-1):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT data FROM updates WHERE session=? AND worker=? AND "
+                "iteration>? ORDER BY iteration, rowid",
+                (session_id, worker_id, since_iteration)).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def close(self):
+        with self._lock:
+            self._conn.close()
+
+
 class RemoteStatsStorageRouter(StatsStorage):
     """Client-side router POSTing every record to a remote TrainingUIServer's
     /collect endpoint (reference core/api/storage/impl/

@@ -1,0 +1,37 @@
+"""Word2Vec with hierarchical softmax over segmented Chinese text.
+
+Demonstrates three round-3 capabilities together: the dictionary+Viterbi
+CJK segmenter (nlp/segmentation.py — the ansj/kuromoji capability), the
+hierarchical-softmax objective (reference useHierarchicSoftmax; batched
+gather over padded Huffman paths), and similarity queries."""
+import numpy as np
+
+from deeplearning4j_tpu.nlp import CJKTokenizerFactory, Word2Vec
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # unsegmented Chinese sentences from two topics (study vs weather)
+    study = ["我们在大学学习机器学习", "学生喜欢学习", "老师教学生机器学习",
+             "我们研究深度学习", "学习机器学习很好"]
+    weather = ["今天天气很好", "明天天气不好", "天气好我们高兴",
+               "昨天天气不好", "今天天气好"]
+    corpus = []
+    for _ in range(60):
+        corpus.append((study if rng.random() < 0.5 else weather)[rng.integers(5)])
+
+    w2v = Word2Vec(layer_size=32, window=3, min_word_frequency=2, epochs=15,
+                   learning_rate=0.05, sample=1e-3, seed=7,
+                   use_hierarchical_softmax=True,
+                   tokenizer_factory=CJKTokenizerFactory(language="zh"))
+    w2v.fit(corpus)
+
+    print("vocab:", len(w2v.vocab), "words (segmented, e.g. 机器学习 is ONE token)")
+    print("sim(学习, 机器学习) =", round(w2v.similarity("学习", "机器学习"), 3))
+    print("sim(学习, 天气)     =", round(w2v.similarity("学习", "天气"), 3))
+    assert w2v.similarity("学习", "机器学习") > w2v.similarity("学习", "天气")
+    print("nearest to 天气:", w2v.words_nearest("天气", 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -235,3 +235,53 @@ def test_tsne_page_renders(tmp_path):
     page = open(p).read()
     assert page.count("<circle") == 40
     assert "&#9679;" in page  # legend
+
+
+def test_sqlite_stats_storage_round_trip(tmp_path):
+    """SQLite indexed backend (reference ui/storage/sqlite module): full SPI
+    round trip incl. since_iteration queries, cross-connection read, and
+    dashboard rendering."""
+    from deeplearning4j_tpu.ui import SqliteStatsStorage, render_dashboard_html
+
+    path = str(tmp_path / "stats.db")
+    store = SqliteStatsStorage(path)
+    store.put_static_info("s1", "w0", {"model_class": "M", "num_params": 7})
+    for i in range(5):
+        store.put_update("s1", "w0", {"iteration": i, "score": 5.0 - i})
+    store.put_update("s1", "w1", {"iteration": 0, "score": 9.0})
+
+    assert store.list_session_ids() == ["s1"]
+    assert store.list_worker_ids("s1") == ["w0", "w1"]
+    assert store.get_static_info("s1", "w0")["num_params"] == 7
+    assert len(store.get_updates("s1", "w0")) == 5
+    assert [u["iteration"] for u in store.get_updates("s1", "w0",
+                                                      since_iteration=2)] == [3, 4]
+    assert store.get_latest_update("s1", "w0")["score"] == 1.0
+
+    # independent connection (dashboard process) sees the same data
+    reader = SqliteStatsStorage(path)
+    page = render_dashboard_html(reader, "s1", "w0")
+    assert "Score vs. iteration" in page
+    reader.close()
+    store.close()
+
+
+def test_sqlite_storage_with_stats_listener(tmp_path):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.ui import SqliteStatsStorage, StatsListener
+
+    store = SqliteStatsStorage(str(tmp_path / "train.db"))
+    conf = (NeuralNetConfiguration(seed=1, updater=Sgd(0.1), dtype="float32")
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(store, session_id="t", worker_id="w"))
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 16)]
+    net.fit(x, y, epochs=3, batch_size=16)
+    ups = store.get_updates("t", "w")
+    assert len(ups) == 3 and all("score" in u for u in ups)
+    store.close()

@@ -54,9 +54,18 @@ def _lstm_scan(x_proj, h0, c0, R, act, gate_act, peepholes=None, mask=None,
                              peepholes=peepholes, mask=mask, reverse=reverse,
                              activation=activation_names[0],
                              gate_activation=activation_names[1]):
+        m2d = None if mask is None else mask[:, :, 0].astype(x_proj.dtype)
+        if reverse:
+            # a reverse LSTM is a forward LSTM over the flipped sequence
+            # (the backward half of GravesBidirectionalLSTM)
+            x_proj = jnp.flip(x_proj, 0)
+            m2d = None if m2d is None else jnp.flip(m2d, 0)
         if peepholes is not None:
-            return fused_lstm_peephole(x_proj, h0, c0, R, *peepholes)
-        return fused_lstm(x_proj, h0, c0, R)
+            hs, final = fused_lstm_peephole(x_proj, h0, c0, R, *peepholes,
+                                            mask=m2d)
+        else:
+            hs, final = fused_lstm(x_proj, h0, c0, R, mask=m2d)
+        return (jnp.flip(hs, 0) if reverse else hs), final
 
     def step(carry, inp):
         h_prev, c_prev = carry
@@ -232,7 +241,9 @@ class GravesBidirectionalLSTM(LayerConf):
             c0 = jnp.zeros((B, H), x.dtype)
             peep = (params[f"pi{d}"], params[f"pf{d}"], params[f"po{d}"])
             hs, _ = _lstm_scan(x_proj, h0, c0, params[f"R{d}"], act, gate_act,
-                               peep, m, reverse=reverse)
+                               peep, m, reverse=reverse,
+                               activation_names=(self.activation or "tanh",
+                                                 self.gate_activation))
             outs.append(hs.transpose(1, 0, 2))
         return outs[0] + outs[1], state
 

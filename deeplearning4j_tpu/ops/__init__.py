@@ -1,5 +1,6 @@
 from .compression import (ThresholdPayload, threshold_decode,
-                          threshold_encode, threshold_roundtrip)
+                          threshold_encode,
+                          threshold_encode_dense, threshold_roundtrip)
 
-__all__ = ["ThresholdPayload", "threshold_decode", "threshold_encode",
+__all__ = ["ThresholdPayload", "threshold_decode", "threshold_encode", "threshold_encode_dense",
            "threshold_roundtrip"]

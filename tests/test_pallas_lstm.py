@@ -217,3 +217,239 @@ def test_graves_layer_training_identical_with_and_without_fused(monkeypatch):
         results[flag] = (net.score(x, y), np.asarray(net.params_flat()))
     assert np.isclose(results["1"][0], results["0"][0], atol=1e-5)
     np.testing.assert_allclose(results["1"][1], results["0"][1], atol=1e-4)
+
+
+def test_masked_forward_and_backward_match_scan():
+    """Masked fused path parity vs the masked scan (variable-length
+    sequences; masked steps carry state unchanged), plain AND peephole."""
+    from deeplearning4j_tpu.ops.pallas_lstm import (fused_lstm,
+                                                    fused_lstm_peephole)
+    T, B, H = 6, 8, 128
+    xp, h0, c0, Rm = _inputs(T, B, H)
+    lens = R.integers(2, T + 1, B)
+    mask = jnp.asarray((np.arange(T)[None, :] < lens[:, None])
+                       .astype(np.float32).T)          # [T, B]
+    pi = jnp.asarray(R.normal(size=(H,)).astype(np.float32) * 0.2)
+    pf = jnp.asarray(R.normal(size=(H,)).astype(np.float32) * 0.2)
+    po = jnp.asarray(R.normal(size=(H,)).astype(np.float32) * 0.2)
+
+    def scan_masked(xp, h0, c0, Rm, peep=None):
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            x, m = inp
+            m = m[:, None]
+            gates = x + h_prev @ Rm
+            zi, zf = gates[:, :H], gates[:, H:2 * H]
+            zo, zg = gates[:, 2 * H:3 * H], gates[:, 3 * H:]
+            if peep is not None:
+                zi = zi + c_prev * peep[0]
+                zf = zf + c_prev * peep[1]
+            i = jax.nn.sigmoid(zi)
+            f = jax.nn.sigmoid(zf)
+            g = jnp.tanh(zg)
+            c = f * c_prev + i * g
+            if peep is not None:
+                zo = zo + c * peep[2]
+            o = jax.nn.sigmoid(zo)
+            h = o * jnp.tanh(c)
+            h = m * h + (1 - m) * h_prev
+            c = m * c + (1 - m) * c_prev
+            return (h, c), h
+        (hT, cT), hs = jax.lax.scan(step, (h0, c0), (xp, mask))
+        return hs, (hT, cT)
+
+    for label, fused_fn, scan_fn, args in [
+            ("plain", lambda *a: fused_lstm(*a, mask=mask),
+             lambda *a: scan_masked(*a), (xp, h0, c0, Rm)),
+            ("peep", lambda *a: fused_lstm_peephole(*a, mask=mask),
+             lambda xp, h0, c0, Rm, pi, pf, po: scan_masked(
+                 xp, h0, c0, Rm, (pi, pf, po)),
+             (xp, h0, c0, Rm, pi, pf, po))]:
+        hs1, (hT1, cT1) = fused_fn(*args)
+        hs2, (hT2, cT2) = scan_fn(*args)
+        np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2),
+                                   atol=1e-6, err_msg=label)
+        np.testing.assert_allclose(np.asarray(cT1), np.asarray(cT2),
+                                   atol=1e-6, err_msg=label)
+        w = jnp.asarray(R.normal(size=hs2.shape).astype(np.float32))
+
+        def loss(f):
+            def lf(*a):
+                hs, (hT, cT) = f(*a)
+                return (jnp.sum(hs * w) + jnp.sum(jnp.tanh(hT))
+                        + jnp.sum(cT * cT) * 0.1)
+            return lf
+        an = tuple(range(len(args)))
+        g1 = jax.grad(loss(fused_fn), argnums=an)(*args)
+        g2 = jax.grad(loss(scan_fn), argnums=an)(*args)
+        for k, (a, b) in enumerate(zip(g1, g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5, err_msg=f"{label} arg{k}")
+
+
+def test_masked_layer_training_identical_with_and_without_fused(monkeypatch):
+    """Whole-net masked training parity between fused and scan paths."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+    def build():
+        conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1),
+                                       dtype="float32")
+                .list(LSTM(n_out=128, activation="tanh"),
+                      RnnOutputLayer(n_out=5, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(5, 6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    x = R.normal(size=(8, 6, 5)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[R.integers(0, 5, (8, 6))]
+    lens = R.integers(2, 7, 8)
+    m = (np.arange(6)[None, :] < lens[:, None]).astype(np.float32)
+    it = ListDataSetIterator([DataSet(x, y, m, m)], batch_size=8)
+    results = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        net = build()
+        net.fit(iterator=it, epochs=3)
+        results[flag] = np.asarray(net.params_flat())
+    np.testing.assert_allclose(results["1"], results["0"], atol=1e-4)
+
+
+def test_bidirectional_layer_fused_matches_scan(monkeypatch):
+    """GravesBidirectionalLSTM (fwd + reverse halves) fused-vs-scan parity:
+    the reverse direction runs fused via flip(inputs) -> forward kernel ->
+    flip(outputs). Covers masked and unmasked."""
+    from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    layer = GravesBidirectionalLSTM(n_in=5, n_out=128, activation="tanh")
+    params, state = layer.init(jax.random.PRNGKey(3),
+                               InputType.recurrent(5, 6), jnp.float32)
+    x = jnp.asarray(R.normal(size=(8, 6, 5)).astype(np.float32))
+    lens = R.integers(2, 7, 8)
+    m = jnp.asarray((np.arange(6)[None, :] < lens[:, None]).astype(np.float32))
+    for mask in (None, m):
+        outs = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+            out, _ = layer.apply(params, state, x, mask=mask)
+            outs[flag] = np.asarray(out)
+        np.testing.assert_allclose(outs["1"], outs["0"], atol=1e-5,
+                                   err_msg=f"mask={'yes' if mask is not None else 'no'}")
+
+    # grads too (the flipped reverse VJP)
+    def loss(p, flag):
+        import os
+        os.environ["DL4J_TPU_FUSED_LSTM"] = flag
+        out, _ = layer.apply(p, state, x, mask=m)
+        return jnp.sum(out * out)
+    g1 = jax.grad(lambda p: loss(p, "1"))(params)
+    g0 = jax.grad(lambda p: loss(p, "0"))(params)
+    import os; os.environ.pop("DL4J_TPU_FUSED_LSTM", None)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g0[k]),
+                                   atol=3e-4, err_msg=k)
+
+
+def test_bf16_forward_and_backward_close_to_f32():
+    """bf16 I/O fused path: compute stays f32 in-kernel (f32 scratch
+    carries + f32 accumulators), so outputs/grads track the f32 kernel to
+    bf16 rounding, not bf16-compounded error."""
+    from deeplearning4j_tpu.ops.pallas_lstm import (fused_lstm,
+                                                    fused_lstm_applicable)
+    assert fused_lstm_applicable(16, 128, jnp.bfloat16, peepholes=None,
+                                 mask=None, reverse=False, activation="tanh",
+                                 gate_activation="sigmoid")
+    assert not fused_lstm_applicable(8, 128, jnp.bfloat16, peepholes=None,
+                                     mask=None, reverse=False,
+                                     activation="tanh",
+                                     gate_activation="sigmoid")  # B%16
+    T, B, H = 6, 16, 128
+    xp, h0, c0, Rm = (jnp.asarray(R.normal(size=s).astype(np.float32) * sc)
+                      for s, sc in [((T, B, 4 * H), 0.3), ((B, H), 0.1),
+                                    ((B, H), 0.1), ((H, 4 * H), 0.1)])
+    bf = jnp.bfloat16
+    hs32, (hT32, cT32) = fused_lstm(xp, h0, c0, Rm)
+    hs16, (hT16, cT16) = fused_lstm(xp.astype(bf), h0.astype(bf),
+                                    c0.astype(bf), Rm.astype(bf))
+    assert hs16.dtype == bf
+    np.testing.assert_allclose(np.asarray(hs16, np.float32),
+                               np.asarray(hs32), atol=0.05)
+
+    def loss(f32_mode):
+        def lf(xp_, R_):
+            hs, (hT, cT) = fused_lstm(xp_, h0.astype(xp_.dtype),
+                                      c0.astype(xp_.dtype), R_)
+            return jnp.sum((hs.astype(jnp.float32)) ** 2)
+        return lf
+    g32 = jax.grad(loss(True), argnums=1)(xp, Rm)
+    g16 = jax.grad(loss(False), argnums=1)(xp.astype(bf), Rm.astype(bf))
+    assert g16.dtype == bf
+    # relative agreement on the dominant gradient entries
+    denom = np.maximum(np.abs(np.asarray(g32)), 1e-2)
+    rel = np.abs(np.asarray(g16, np.float32) - np.asarray(g32)) / denom
+    assert float(rel.mean()) < 0.05, float(rel.mean())
+
+
+def test_bf16_layer_runs_fused(monkeypatch):
+    """A bf16 LSTM net trains on the fused path and tracks the scan path."""
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1),
+                                       dtype="bfloat16")
+                .list(LSTM(n_out=128, activation="tanh"),
+                      RnnOutputLayer(n_out=5, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(5, 6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    x = R.normal(size=(16, 6, 5)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[R.integers(0, 5, (16, 6))]
+    scores = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        net = build()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=3, batch_size=16)
+        scores[flag] = (s0, net.score(x, y))
+    assert scores["1"][1] < scores["1"][0]
+    assert np.isclose(scores["1"][1], scores["0"][1], rtol=0.05)
+
+
+def test_masked_bf16_matches_f32_masked():
+    """The masked bf16 fused path (reachable in production: bf16 net +
+    sequence masks) tracks the masked f32 kernel to bf16 rounding."""
+    from deeplearning4j_tpu.ops.pallas_lstm import (fused_lstm,
+                                                    fused_lstm_applicable)
+    T, B, H = 6, 16, 128
+    assert fused_lstm_applicable(B, H, jnp.bfloat16, peepholes=None,
+                                 mask=object(), reverse=False,
+                                 activation="tanh",
+                                 gate_activation="sigmoid")
+    xp, h0, c0, Rm = (jnp.asarray(R.normal(size=s).astype(np.float32) * sc)
+                      for s, sc in [((T, B, 4 * H), 0.3), ((B, H), 0.1),
+                                    ((B, H), 0.1), ((H, 4 * H), 0.1)])
+    lens = R.integers(2, T + 1, B)
+    mask = jnp.asarray((np.arange(T)[None, :] < lens[:, None])
+                       .astype(np.float32).T)
+    bf = jnp.bfloat16
+    hs32, (hT32, cT32) = fused_lstm(xp, h0, c0, Rm, mask=mask)
+    hs16, (hT16, cT16) = fused_lstm(xp.astype(bf), h0.astype(bf),
+                                    c0.astype(bf), Rm.astype(bf),
+                                    mask=mask.astype(bf))
+    assert hs16.dtype == bf
+    np.testing.assert_allclose(np.asarray(hs16, np.float32),
+                               np.asarray(hs32), atol=0.05)
+    # masked steps still carry the (bf16-rounded) previous state exactly
+    g16 = jax.grad(lambda R_: jnp.sum(
+        fused_lstm(xp.astype(bf), h0.astype(bf), c0.astype(bf), R_,
+                   mask=mask.astype(bf))[0].astype(jnp.float32) ** 2))(
+        Rm.astype(bf))
+    assert g16.dtype == bf and np.isfinite(np.asarray(g16, np.float32)).all()

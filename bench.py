@@ -31,8 +31,12 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    4/8-device virtual CPU meshes (BASELINE #5;
                                    chips unavailable, so this measures mesh +
                                    collective dispatch overhead, not ICI)
-  - threshold_encode_ms_25m        threshold encode+decode on a 25M-param
-                                   flat gradient (DCN codec overhead)
+  - threshold_encode_ms_25m        {topk_ms, dense_est_ms, dense_note}:
+                                   bounded-payload top-k encode+decode
+                                   (measured) vs the dense reference-
+                                   semantics encoder (bandwidth-bound
+                                   cost-analysis estimate), both on a
+                                   25M-param flat gradient (DCN codec cost)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
 BENCH_BUDGET_S, BENCH_PEAK_TFLOPS, BENCH_REPEATS (timed windows per bench,
@@ -366,19 +370,26 @@ def bench_word2vec():
 
     loss_before = float(probe_loss(syn0, syn1))
 
-    def wrapped(syn0, syn1, key):
+    def wrapped(xs, carry):
+        syn0, syn1, key = carry
         k1, k2 = jax.random.split(key)
-        s0, s1 = step(syn0, syn1, centers, contexts, k1)
+        salt = jnp.sum(xs * 0).astype(centers.dtype)
+        s0, s1 = step(syn0, syn1, centers + salt, contexts, k1)
         return s0, s1, k2
 
-    dt = _time_steps(wrapped, [syn0, syn1, key], STEPS)
+    # device-slope timing: the SGNS step is well under the tunnel's per-call
+    # dispatch floor (see _loop_slope_time)
+    dt = _loop_slope_time(wrapped,
+                          (jnp.zeros((8, 128), jnp.float32),
+                           (syn0, syn1, key)))
 
     # the quality gate: a few more optimizer steps from scratch must
     # strictly reduce the probe loss
     s0 = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32) * 0.01)
     s1, k = jnp.zeros((V, D), jnp.float32), jax.random.PRNGKey(7)
+    zero_salt = jnp.zeros((8, 128), jnp.float32)
     for _ in range(10):
-        s0, s1, k = wrapped(s0, s1, k)
+        s0, s1, k = wrapped(zero_salt, (s0, s1, k))
     loss_after = float(probe_loss(s0, s1))
     if not loss_after < loss_before:
         raise RuntimeError(
@@ -390,11 +401,14 @@ def bench_word2vec():
 
 
 def bench_threshold_encode():
-    """Encode+decode ms on a 25M-element flat gradient (ResNet-50 scale) —
-    the DCN compression overhead per step (VERDICT r1 item 5)."""
+    """Encode(+decode) ms on a 25M-element flat gradient (ResNet-50 scale):
+    the bounded-payload top-k format (the ~90ms top_k cost) AND the dense
+    reference-semantics encoder (elementwise; what EncodedAccumulator uses
+    by default)."""
     import jax
     import jax.numpy as jnp
-    from deeplearning4j_tpu.ops.compression import threshold_roundtrip
+    from deeplearning4j_tpu.ops.compression import (threshold_encode_dense,
+                                                    threshold_roundtrip)
 
     n = 25_000_000
     g = jnp.asarray(np.random.default_rng(0).normal(size=(n,)).astype(np.float32))
@@ -407,7 +421,28 @@ def bench_threshold_encode():
         return (new_res,)
 
     dt = _time_steps(step, [g], max(5, STEPS // 2))
-    return dt * 1e3
+
+    # The dense encoder is a single fused elementwise pass; its ~0.25ms is
+    # far below every transport artifact on this rig (slope AND chained
+    # timings both read ~0 — not credible), so report a bandwidth-bound
+    # ESTIMATE from XLA's compiled cost analysis instead of a fake
+    # measurement: bytes-accessed / HBM bandwidth (v5e ~819 GB/s).
+    out = {"topk_ms": round(dt * 1e3, 3)}
+    try:
+        compiled = jax.jit(
+            lambda r: threshold_encode_dense(r, 1e-3)[1]).lower(g).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        hbm_gbps = float(os.environ.get("BENCH_HBM_GBPS", "819"))
+        dense_est = float(ca.get("bytes accessed", 2e8)) / (hbm_gbps * 1e9)
+        out["dense_est_ms"] = round(dense_est * 1e3, 3)
+        out["dense_note"] = ("estimate = bytes_accessed / HBM bandwidth "
+                             "(elementwise op, unmeasurably fast vs "
+                             "transport)")
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"dense cost-analysis estimate unavailable: {e}",
+              file=sys.stderr)
+    return out
 
 
 def bench_collective_overhead():

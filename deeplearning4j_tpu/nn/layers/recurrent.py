@@ -50,8 +50,10 @@ def _lstm_scan(x_proj, h0, c0, R, act, gate_act, peepholes=None, mask=None,
     H = h0.shape[-1]
     from ...ops.pallas_lstm import (fused_lstm, fused_lstm_applicable,
                                     fused_lstm_peephole)
+    # probe with reverse=False: THIS dispatcher implements reverse by
+    # flipping inputs/outputs around the forward-only kernels
     if fused_lstm_applicable(h0.shape[0], H, x_proj.dtype,
-                             peepholes=peepholes, mask=mask, reverse=reverse,
+                             peepholes=peepholes, mask=mask, reverse=False,
                              activation=activation_names[0],
                              gate_activation=activation_names[1]):
         m2d = None if mask is None else mask[:, :, 0].astype(x_proj.dtype)

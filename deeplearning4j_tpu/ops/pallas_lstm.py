@@ -60,8 +60,10 @@ def fused_lstm_applicable(B: int, H: int, dtype, *, peepholes, mask,
         return False
     if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
         return False
-    # reverse is handled by the dispatcher (flip inputs, run forward, flip
-    # outputs — see _lstm_scan), so it does not gate the fused path
+    if reverse:
+        # the kernels are forward-only; a reverse caller must flip inputs/
+        # outputs itself and probe with reverse=False, as _lstm_scan does
+        return False
     if activation != "tanh" or gate_activation != "sigmoid":
         return False
     dt = jnp.dtype(dtype)

@@ -1,5 +1,7 @@
 from .dataset import (AsyncDataSetIterator, DataSet, DataSetIterator,
                       ListDataSetIterator)
+from .export import (ShardedFileDataSetIterator,
+                     export_dataset_iterator)
 from .fetchers import (Cifar10DataSetIterator, CurvesDataSetIterator,
                        IrisDataSetIterator, LFWDataSetIterator,
                        load_cifar10, load_curves, load_iris, load_lfw)
@@ -16,6 +18,7 @@ __all__ = [
     "IteratorDataSetIterator", "LFWDataSetIterator",
     "ListDataSetIterator",
     "ListMultiDataSetIterator", "MnistDataSetIterator", "MultiDataSet",
-    "MultipleEpochsIterator", "SamplingDataSetIterator", "load_cifar10",
+    "MultipleEpochsIterator", "SamplingDataSetIterator",
+    "ShardedFileDataSetIterator", "export_dataset_iterator", "load_cifar10",
     "load_curves", "load_iris", "load_lfw", "load_mnist",
 ]

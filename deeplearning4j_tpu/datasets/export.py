@@ -1,0 +1,173 @@
+"""Export-based dataset pipeline: save minibatches as sharded files, stream
+them back per worker.
+
+Reference: the Spark parameter-averaging master's DEFAULT export path —
+`ParameterAveragingTrainingMaster.executeTraining` first exports the RDD to
+saved minibatch files and workers then stream those files
+(`spark/impl/paramavg/ParameterAveragingTrainingMaster.java:326-335`,
+`spark/util/ExportSupport`, `spark/iterator/PortableDataStreamDataSetIterator`).
+The point of that design survives on TPU pods: decouple (slow, once)
+preprocessing from (fast, repeated) training epochs, and let each host read
+only ITS shards instead of shipping batches through a driver.
+
+Format: one `.npz` per shard holding `features_<i>`, `labels_<i>` (+ optional
+`features_mask_<i>` / `labels_mask_<i>`) for each minibatch i, plus a
+`manifest.json` with shard/batch counts — plain numpy files any tool can
+read.
+
+Multi-host: `ShardedFileDataSetIterator(dir, shard_index=k, num_shards=n)`
+reads the k-th of n interleaved shard subsets; `for_process()` picks
+`jax.process_index()/process_count()` so the same script works on one host
+or a pod.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .dataset import DataSet, DataSetIterator
+
+
+def export_dataset_iterator(iterator, out_dir: str, *,
+                            batches_per_shard: int = 16,
+                            prefix: str = "shard") -> dict:
+    """Write every DataSet from ``iterator`` into ``out_dir`` as .npz shards
+    (reference ExportSupport.exportIfRequired). Returns the manifest dict."""
+    os.makedirs(out_dir, exist_ok=True)
+    shard, batch_in_shard, n_batches, n_examples = 0, 0, 0, 0
+    bufs: dict = {}
+    shards = []
+
+    def flush():
+        nonlocal shard, batch_in_shard, bufs
+        if not bufs:
+            return
+        path = os.path.join(out_dir, f"{prefix}_{shard:05d}.npz")
+        np.savez(path, **bufs)
+        shards.append({"file": os.path.basename(path),
+                       "batches": batch_in_shard})
+        shard += 1
+        batch_in_shard = 0
+        bufs = {}
+
+    def put(name, value):
+        # multi-input/multi-output graphs carry list features/labels
+        # (optimize/solver.py handles the same shape); store each part as
+        # <name>_inJ so the reader reconstructs the list faithfully
+        if isinstance(value, (list, tuple)):
+            for j, v in enumerate(value):
+                if v is not None:
+                    bufs[f"{name}_in{j}"] = np.asarray(v)
+        else:
+            bufs[name] = np.asarray(value)
+
+    for ds in iterator:
+        i = batch_in_shard
+        put(f"features_{i}", ds.features)
+        put(f"labels_{i}", ds.labels)
+        if ds.features_mask is not None:
+            put(f"features_mask_{i}", ds.features_mask)
+        if ds.labels_mask is not None:
+            put(f"labels_mask_{i}", ds.labels_mask)
+        batch_in_shard += 1
+        n_batches += 1
+        n_examples += ds.num_examples()
+        if batch_in_shard >= batches_per_shard:
+            flush()
+    flush()
+    manifest = {"version": 1, "prefix": prefix, "num_shards": len(shards),
+                "num_batches": n_batches, "num_examples": n_examples,
+                "shards": shards}
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+class ShardedFileDataSetIterator(DataSetIterator):
+    """Stream exported shards back as DataSets (reference
+    PortableDataStreamDataSetIterator / the worker side of the export path).
+
+    ``shard_index``/``num_shards`` select an interleaved subset of shard
+    FILES (shard i goes to worker i % num_shards) so every worker streams a
+    disjoint, load-balanced partition without a driver in the loop. Files
+    are memory-mapped lazily — one shard resident at a time.
+    """
+
+    def __init__(self, data_dir: str, *, shard_index: int = 0,
+                 num_shards: int = 1, shuffle_shards: bool = False,
+                 seed: int = 0):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} out of range for "
+                             f"num_shards {num_shards}")
+        self.data_dir = data_dir
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.shuffle_shards = shuffle_shards
+        self._rng = np.random.default_rng(seed)
+        mpath = os.path.join(data_dir, "manifest.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                self.manifest = json.load(f)
+            files = [s["file"] for s in self.manifest["shards"]]
+        else:   # manifest-less directory of npz files still works
+            self.manifest = None
+            files = sorted(os.path.basename(p) for p in
+                           glob.glob(os.path.join(data_dir, "*.npz")))
+        if not files:
+            raise FileNotFoundError(f"No exported shards in {data_dir!r}")
+        self._files = [f for i, f in enumerate(files)
+                       if i % num_shards == shard_index]
+        if not self._files:
+            # an empty partition would make this worker iterate zero batches
+            # while its peers wait in collectives — fail at construction
+            raise ValueError(
+                f"Worker {shard_index}/{num_shards} gets no shards: only "
+                f"{len(files)} shard file(s) in {data_dir!r}. Re-export with "
+                f"a smaller batches_per_shard so every worker has data")
+
+    @classmethod
+    def for_process(cls, data_dir: str, **kw) -> "ShardedFileDataSetIterator":
+        """Partition by jax process: worker k of n on a multi-host pod
+        streams its own shard subset (reference: each Spark executor reads
+        its partition's export files)."""
+        import jax
+        return cls(data_dir, shard_index=jax.process_index(),
+                   num_shards=jax.process_count(), **kw)
+
+    @staticmethod
+    def _get(z, name):
+        """Reassemble a possibly multi-part value: <name> (single array) or
+        <name>_in0.._inJ (list features/labels of a multi-input graph)."""
+        if name in z.files:
+            return z[name]
+        parts = sorted((k for k in z.files
+                        if re.fullmatch(re.escape(name) + r"_in\d+", k)),
+                       key=lambda k: int(k.rsplit("_in", 1)[1]))
+        if parts:
+            return [z[k] for k in parts]
+        return None
+
+    def __iter__(self) -> Iterator[DataSet]:
+        order = list(self._files)
+        if self.shuffle_shards:
+            self._rng.shuffle(order)
+        for fname in order:
+            with np.load(os.path.join(self.data_dir, fname)) as z:
+                n = 0
+                while (f"features_{n}" in z.files
+                       or f"features_{n}_in0" in z.files):
+                    n += 1
+                for i in range(n):
+                    yield DataSet(
+                        self._get(z, f"features_{i}"),
+                        self._get(z, f"labels_{i}"),
+                        self._get(z, f"features_mask_{i}"),
+                        self._get(z, f"labels_mask_{i}"))
+
+    def reset(self):
+        pass

@@ -388,3 +388,49 @@ def test_cjk_segmenter_drops_punctuation():
         "今天天气很好。我喜欢吃苹果！").get_tokens()
     assert "。" not in toks and "！" not in toks
     assert "今天" in toks and "苹果" in toks
+
+
+# ------------------------------------------------- POS tagging (UIMA analogue)
+def test_rule_based_pos_tagger():
+    from deeplearning4j_tpu.nlp import RuleBasedPosTagger
+    t = RuleBasedPosTagger()
+    toks = "the quick dog quickly ate 42 sandwiches in London".split()
+    tags = t.tag(toks)
+    assert tags[0] == "DT"
+    assert tags[3] == "RB"          # quickly
+    assert tags[4] == "VBD"         # ate (lexicon)
+    assert tags[5] == "CD"          # 42
+    assert tags[6] == "NNS"         # sandwiches
+    assert tags[7] == "IN"
+    assert tags[8] == "NNP"         # London (mid-sentence capital)
+    # sentence-initial capital is NOT auto-NNP
+    assert t.tag(["Running", "works"])[0] == "VBG"
+
+
+def test_pos_filter_tokenizer_factory():
+    """Reference PosUimaTokenizerFactory(allowedPosTags): noun-only
+    tokenization for embedding corpora."""
+    from deeplearning4j_tpu.nlp import PosFilterTokenizerFactory
+    tf = PosFilterTokenizerFactory(["NN*"])
+    toks = tf.create("the hungry dog quickly ate two big sandwiches "
+                     "in the kitchen").get_tokens()
+    assert "dog" in toks and "sandwiches" in toks and "kitchen" in toks
+    assert "quickly" not in toks and "ate" not in toks and "the" not in toks
+    # exact-tag filtering + preprocessor seam
+    from deeplearning4j_tpu.nlp import CommonPreprocessor
+    tf2 = PosFilterTokenizerFactory(["VBD", "VBG"],
+                                    pre_processor=CommonPreprocessor())
+    toks2 = tf2.create("She was running and ate quickly").get_tokens()
+    assert "running" in toks2 and "ate" in toks2 and "quickly" not in toks2
+
+
+def test_pos_filtered_word2vec():
+    from deeplearning4j_tpu.nlp import PosFilterTokenizerFactory, Word2Vec
+    corpus = ["the dog quickly ate the food in the house",
+              "a cat slowly drank the water in the kitchen"] * 20
+    w2v = Word2Vec(layer_size=16, window=3, min_word_frequency=1, epochs=3,
+                   negative=3, seed=4,
+                   tokenizer_factory=PosFilterTokenizerFactory(["NN*"]))
+    w2v.fit(corpus)
+    assert w2v.has_word("dog") and w2v.has_word("kitchen")
+    assert not w2v.has_word("quickly") and not w2v.has_word("the")

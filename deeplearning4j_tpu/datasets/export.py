@@ -58,8 +58,15 @@ def export_dataset_iterator(iterator, out_dir: str, *,
     def put(name, value):
         # multi-input/multi-output graphs carry list features/labels
         # (optimize/solver.py handles the same shape); store each part as
-        # <name>_inJ so the reader reconstructs the list faithfully
+        # <name>_inJ — the index in the key preserves positions, and a
+        # <name>_len marker keeps None holes (e.g. labels_mask [None, m])
+        # reconstructible. None scalars (unlabeled DataSets) are skipped
+        # entirely: np.asarray(None) would pickle an object array that
+        # np.load(allow_pickle=False) later refuses.
+        if value is None:
+            return
         if isinstance(value, (list, tuple)):
+            bufs[f"{name}_len"] = np.asarray(len(value), np.int64)
             for j, v in enumerate(value):
                 if v is not None:
                     bufs[f"{name}_in{j}"] = np.asarray(v)
@@ -142,14 +149,17 @@ class ShardedFileDataSetIterator(DataSetIterator):
     @staticmethod
     def _get(z, name):
         """Reassemble a possibly multi-part value: <name> (single array) or
-        <name>_in0.._inJ (list features/labels of a multi-input graph)."""
+        <name>_len + <name>_inJ (list features/labels of a multi-input
+        graph, with None holes preserved at their positions)."""
         if name in z.files:
             return z[name]
-        parts = sorted((k for k in z.files
-                        if re.fullmatch(re.escape(name) + r"_in\d+", k)),
-                       key=lambda k: int(k.rsplit("_in", 1)[1]))
-        if parts:
-            return [z[k] for k in parts]
+        if f"{name}_len" in z.files:
+            out = [None] * int(z[f"{name}_len"])
+            for k in z.files:
+                m = re.fullmatch(re.escape(name) + r"_in(\d+)", k)
+                if m:
+                    out[int(m.group(1))] = z[k]
+            return out
         return None
 
     def __iter__(self) -> Iterator[DataSet]:
@@ -160,7 +170,7 @@ class ShardedFileDataSetIterator(DataSetIterator):
             with np.load(os.path.join(self.data_dir, fname)) as z:
                 n = 0
                 while (f"features_{n}" in z.files
-                       or f"features_{n}_in0" in z.files):
+                       or f"features_{n}_len" in z.files):
                     n += 1
                 for i in range(n):
                     yield DataSet(

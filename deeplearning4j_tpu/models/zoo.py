@@ -66,9 +66,11 @@ def _res_identity_block(g, name, inp, filters):
 
 def resnet50(n_classes: int = 1000, *, height: int = 224, width: int = 224,
              channels: int = 3, seed: int = 42, updater=None,
-             dtype: str = "float32") -> ComputationGraph:
-    """Reference zoo/model/ResNet50.java graphBuilder :82 (stages [3,4,6,3])."""
-    g = _base_builder(seed, updater, dtype)
+             dtype: str = "float32",
+             compute_dtype=None) -> ComputationGraph:
+    """Reference zoo/model/ResNet50.java graphBuilder :82 (stages [3,4,6,3]).
+    ``compute_dtype='bfloat16'`` trains mixed-precision (f32 master)."""
+    g = _base_builder(seed, updater, dtype, compute_dtype=compute_dtype)
     g.add_inputs("input")
     x = _conv_bn(g, "stem", "input", 64, (7, 7), (2, 2))
     g.add_layer("stem_pool", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),

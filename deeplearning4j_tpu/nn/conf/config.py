@@ -46,6 +46,11 @@ class MultiLayerConfiguration:
     # replacement for the reference's activation-caching knobs; deep stacks /
     # long sequences fit in memory at ~1.3x step cost)
     gradient_checkpointing: bool = False
+    # mixed precision: keep MASTER params/updater state in ``dtype`` (f32)
+    # but run the forward/backward compute in this dtype (e.g. 'bfloat16'
+    # for the MXU fast path). Net-new beyond the reference — ND4J-era
+    # DL4J has no AMP; on TPU it is the standard training recipe.
+    compute_dtype: Optional[str] = None
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -80,7 +85,8 @@ class NeuralNetConfiguration:
                  gradient_normalization_threshold: float = 1.0,
                  dtype: str = "float32", optimization_algorithm: str = "sgd",
                  max_num_line_search_iterations: int = 5,
-                 gradient_checkpointing: bool = False, **workspace_noops):
+                 gradient_checkpointing: bool = False,
+                 compute_dtype: Optional[str] = None, **workspace_noops):
         if updater is None:
             updater = Sgd(learning_rate=learning_rate if learning_rate is not None else 0.1)
         elif isinstance(updater, str):
@@ -104,6 +110,7 @@ class NeuralNetConfiguration:
         self.optimization_algorithm = optimization_algorithm.lower()
         self.max_num_line_search_iterations = max_num_line_search_iterations
         self.gradient_checkpointing = gradient_checkpointing
+        self.compute_dtype = compute_dtype
 
     # --- cascade (reference :604-608): fill None fields from globals ---
     def _cascade(self, layer):
@@ -209,7 +216,8 @@ class ListBuilder:
             updater=nc.updater,
             optimization_algorithm=nc.optimization_algorithm,
             max_num_line_search_iterations=nc.max_num_line_search_iterations,
-            gradient_checkpointing=nc.gradient_checkpointing)
+            gradient_checkpointing=nc.gradient_checkpointing,
+            compute_dtype=nc.compute_dtype)
 
 
 def _infer_n_in(layer, itype):

@@ -41,6 +41,7 @@ class ComputationGraphConfiguration:
     optimization_algorithm: str = "sgd"
     max_num_line_search_iterations: int = 5
     gradient_checkpointing: bool = False   # see MultiLayerConfiguration
+    compute_dtype: Optional[str] = None    # see MultiLayerConfiguration
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -189,4 +190,5 @@ class GraphBuilder:
             updater=nc.updater,
             optimization_algorithm=nc.optimization_algorithm,
             max_num_line_search_iterations=nc.max_num_line_search_iterations,
-            gradient_checkpointing=nc.gradient_checkpointing)
+            gradient_checkpointing=nc.gradient_checkpointing,
+            compute_dtype=nc.compute_dtype)

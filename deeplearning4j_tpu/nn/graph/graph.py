@@ -103,6 +103,16 @@ class ComputationGraph:
         inputs = _as_list(inputs)
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        # mixed precision (see MultiLayerNetwork.apply_fn): master params stay
+        # conf.dtype; compute runs in compute_dtype
+        cd = getattr(self.conf, "compute_dtype", None)
+        if cd:
+            # state deliberately NOT cast — see MultiLayerNetwork.apply_fn
+            from ..multilayer import cast_floats
+            params = cast_floats(params, cd)
+            inputs = cast_floats(inputs, cd)
+            if rnn_states is not None:
+                rnn_states = cast_floats(rnn_states, cd)
         acts: Dict[str, Any] = dict(zip(self.conf.network_inputs, inputs))
         masks: Dict[str, Any] = {}
         if features_masks is not None:
@@ -156,6 +166,11 @@ class ComputationGraph:
             if in_mask is not None and getattr(out, "ndim", 0) == 3 and \
                     out.shape[1] == in_mask.shape[1]:
                 masks[name] = in_mask
+        if cd:
+            from ..multilayer import cast_floats
+            new_state = cast_floats(new_state, self.conf.dtype)
+            rnn_out = cast_floats(rnn_out, self.conf.dtype)
+            acts = cast_floats(acts, self.conf.dtype)
         if collect_rnn_states:
             return acts, tuple(new_state), rnn_out
         return acts, tuple(new_state)
@@ -203,8 +218,16 @@ class ComputationGraph:
             if v.preprocessor is not None:
                 feed = v.preprocessor.apply(feed)
             rng, sub = jax.random.split(rng)
+            cd = getattr(self.conf, "compute_dtype", None)
+            head_params = params[vi]
+            if cd:
+                from ..multilayer import cast_floats
+                head_params = cast_floats(head_params, cd)
+                feed = cast_floats(feed, cd)
             per_ex = v.layer_conf.compute_loss_per_example(
-                params[vi], feed, labels[k], lmasks[k], train=train, rng=sub)
+                head_params, feed, labels[k], lmasks[k], train=train, rng=sub)
+            if cd:
+                per_ex = per_ex.astype(jnp.dtype(self.conf.dtype))
             lm = lmasks[k]
             if lm is not None and per_ex.ndim == 1 and lm.ndim >= 2:
                 total = total + jnp.sum(per_ex) / jnp.maximum(jnp.sum(lm), 1.0)

@@ -30,6 +30,16 @@ def _dtype_of(conf) -> Any:
     return jnp.dtype(conf.dtype)
 
 
+def cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree (mixed-precision boundary;
+    integer leaves — embedding ids, step counters — pass through)."""
+    dt = jnp.dtype(dtype)
+    return jax.tree.map(
+        lambda a: a.astype(dt)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a, tree)
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -86,6 +96,20 @@ class MultiLayerNetwork:
         n = len(self.layers) if to_layer is None else to_layer + 1
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        # mixed precision: master params stay conf.dtype (f32); the traced
+        # compute runs in compute_dtype — jax.grad through these casts yields
+        # f32 master gradients automatically (the cast's VJP casts back)
+        cd = getattr(self.conf, "compute_dtype", None)
+        if cd:
+            # params/inputs cast down; STATE is deliberately left at master
+            # precision — layers cast their own state for compute (e.g.
+            # BatchNormalization keeps f32 running stats and casts to x.dtype
+            # itself, norm.py), so casting here would re-quantize the EMA
+            # accumulators every step
+            params = cast_floats(params, cd)
+            x = cast_floats(x, cd)
+            if rnn_states is not None:
+                rnn_states = cast_floats(rnn_states, cd)
         cur_mask = features_mask
         if features_mask is not None:
             m = jnp.asarray(features_mask, x.dtype)
@@ -127,6 +151,13 @@ class MultiLayerNetwork:
             acts.append(x)
             if x.ndim < 3:
                 cur_mask = None   # time dimension collapsed
+        if cd:
+            # storage/API boundary: running stats + carried rnn state at
+            # master precision; activations back to f32 so output()/
+            # feed_forward()/evaluate() keep their dtype contract
+            new_state = cast_floats(new_state, self.conf.dtype)
+            rnn_out = cast_floats(rnn_out, self.conf.dtype)
+            acts = cast_floats(acts, self.conf.dtype)
         if collect_rnn_states:
             return acts, tuple(new_state), rnn_out
         return acts, tuple(new_state)
@@ -169,8 +200,14 @@ class MultiLayerNetwork:
         if pre is not None:
             feed = pre.apply(feed)
         rng, sub = jax.random.split(rng)
+        cd = getattr(self.conf, "compute_dtype", None)
+        head_params = cast_floats(params[-1], cd) if cd else params[-1]
+        if cd:
+            feed = cast_floats(feed, cd)
         per_ex = out_layer.compute_loss_per_example(
-            params[-1], feed, labels, labels_mask, train=train, rng=sub)
+            head_params, feed, labels, labels_mask, train=train, rng=sub)
+        if cd:
+            per_ex = per_ex.astype(jnp.dtype(self.conf.dtype))  # f32 reduce
         if labels_mask is not None and per_ex.ndim == 1 and labels_mask.ndim >= 2:
             # per-timestep masked mean: normalize by active timesteps
             denom = jnp.maximum(jnp.sum(labels_mask), 1.0)

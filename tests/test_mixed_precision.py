@@ -1,0 +1,174 @@
+"""Mixed-precision training (compute_dtype='bfloat16' with f32 master
+params) — net-new beyond the reference (ND4J-era DL4J has no AMP); on TPU
+it is the standard training recipe: bf16 MXU compute, f32 master weights
+and updater state."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                          ConvolutionLayer, DenseLayer, LSTM,
+                                          OutputLayer, RnnOutputLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+R = np.random.default_rng(21)
+
+
+def _xor_data(n=256):
+    x = R.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] * x[:, 1] > 0).astype(int)]
+    return x, y
+
+
+def test_mln_amp_trains_with_f32_master_params():
+    conf = (NeuralNetConfiguration(seed=1, updater=Adam(5e-3),
+                                   dtype="float32", compute_dtype="bfloat16")
+            .list(DenseLayer(n_in=4, n_out=32, activation="tanh"),
+                  DenseLayer(n_out=32, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # master params are f32
+    assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())
+    x, y = _xor_data()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=30, batch_size=64)
+    assert net.score(x, y) < s0 * 0.7
+    # ... and STAY f32 after jitted donated training steps
+    assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())
+    assert net.evaluate(x, y).accuracy() > 0.8
+
+
+def test_amp_gradients_are_f32_and_track_full_precision():
+    conf_kw = dict(seed=3, updater=Sgd(0.1), dtype="float32")
+    layers = lambda: (DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+    amp = MultiLayerNetwork(
+        NeuralNetConfiguration(compute_dtype="bfloat16", **conf_kw)
+        .list(*layers()).build()).init()
+    full = MultiLayerNetwork(
+        NeuralNetConfiguration(**conf_kw).list(*layers()).build()).init()
+    full.set_params_flat(amp.params_flat())
+
+    x, y = _xor_data(64)
+
+    def grads_of(net):
+        g = jax.grad(lambda p: net.loss_fn(p, net.state, x, y,
+                                           train=False)[0])(net.params)
+        return g
+
+    g_amp = grads_of(amp)
+    # master gradients come back f32 (the cast's VJP casts back)
+    assert all(v.dtype == jnp.float32 for p in g_amp for v in p.values())
+    g_full = grads_of(full)
+    fa = np.concatenate([np.ravel(v) for p in g_amp for v in p.values()])
+    ff = np.concatenate([np.ravel(v) for p in g_full for v in p.values()])
+    denom = np.maximum(np.abs(ff), 1e-2)
+    assert float((np.abs(fa - ff) / denom).mean()) < 0.05
+
+
+def test_amp_cnn_batchnorm_state_stays_f32():
+    conf = (NeuralNetConfiguration(seed=5, updater=Adam(1e-3),
+                                   dtype="float32", compute_dtype="bfloat16")
+            .list(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                   convolution_mode="same", activation="relu"),
+                  BatchNormalization(),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(16, 8, 8, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[R.integers(0, 3, 16)]
+    net.fit(x, y, epochs=3, batch_size=16)
+    # BN running stats stored at master precision
+    bn_state = net.state[1]
+    assert all(v.dtype == jnp.float32 for v in bn_state.values())
+    out = np.asarray(net.output(x))
+    assert np.isfinite(out).all() and out.shape == (16, 3)
+
+
+def test_amp_lstm_rides_fused_kernel(monkeypatch):
+    """bf16 compute_dtype feeds the LSTM the bf16 fused kernel path."""
+    conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1), dtype="float32",
+                                   compute_dtype="bfloat16")
+            .list(LSTM(n_out=128, activation="tanh"),
+                  RnnOutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(5, 6)).build())
+    x = R.normal(size=(16, 6, 5)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[R.integers(0, 5, (16, 6))]
+    scores = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_LSTM", flag)
+        net = MultiLayerNetwork(conf).init()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=3, batch_size=16)
+        scores[flag] = net.score(x, y)
+        assert scores[flag] < s0
+        assert all(v.dtype == jnp.float32 for p in net.params
+                   for v in p.values())
+    assert np.isclose(scores["1"], scores["0"], rtol=0.05)
+
+
+def test_amp_computation_graph_and_serde():
+    b = (NeuralNetConfiguration(seed=9, updater=Adam(5e-3), dtype="float32",
+                                compute_dtype="bfloat16")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_out=16, activation="tanh"), "in")
+         .add_layer("d2", DenseLayer(n_out=16, activation="relu"), "in")
+         .add_vertex("m", MergeVertex(), "d1", "d2")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "m")
+         .set_outputs("out").set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(b.build()).init()
+    x, y = _xor_data(128)
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=20, batch_size=64)
+    assert net.score(x, y) < s0
+    assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())
+    # compute_dtype survives the config JSON round trip
+    from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+    conf2 = ComputationGraphConfiguration.from_json(net.conf.to_json())
+    assert conf2.compute_dtype == "bfloat16"
+
+
+def test_amp_outputs_are_master_dtype_and_bn_stats_full_precision():
+    """The public API stays f32 under AMP (outputs/evaluate), and BN running
+    stats accumulate at FULL precision (not bf16-requantized each step)."""
+    conf = (NeuralNetConfiguration(seed=11, updater=Sgd(0.05),
+                                   dtype="float32", compute_dtype="bfloat16")
+            .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                  BatchNormalization(),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = _xor_data(64)
+    out = net.output(x)
+    assert out.dtype == jnp.float32          # API dtype contract
+
+    # precision check FROM SHARED FRESH STATE: one train-mode forward updates
+    # the EMA once on both an AMP and a full-precision net with identical
+    # params; the f32 accumulator must track the f32 run to ~bf16 forward
+    # noise, and stay stored at f32
+    full = MultiLayerNetwork(
+        NeuralNetConfiguration(seed=11, updater=Sgd(0.05), dtype="float32")
+        .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+              BatchNormalization(),
+              OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .build()).init()
+    full.set_params_flat(net.params_flat())
+    _, s_amp = net.apply_fn(net.params, net.state, jnp.asarray(x), train=True)
+    _, s_full = full.apply_fn(full.params, full.state, jnp.asarray(x),
+                              train=True)
+    assert s_amp[1]["mean"].dtype == jnp.float32
+    a, f = np.asarray(s_amp[1]["mean"]), np.asarray(s_full[1]["mean"])
+    denom = np.maximum(np.abs(f), 1e-3)
+    assert float((np.abs(a - f) / denom).mean()) < 0.02, (a, f)

@@ -419,3 +419,29 @@ def test_export_multi_input_and_empty_partition(tmp_path):
     with pytest.raises(ValueError, match="gets no shards"):
         ShardedFileDataSetIterator(str(tmp_path / "mi"), shard_index=1,
                                    num_shards=2)  # only 1 shard file
+
+
+def test_export_none_labels_and_none_holes(tmp_path):
+    """Unlabeled DataSets export/read back (labels stay None — no pickled
+    object arrays); list values keep None holes at their positions."""
+    from deeplearning4j_tpu.datasets import (ShardedFileDataSetIterator,
+                                             export_dataset_iterator)
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+    x = np.ones((4, 3), np.float32)
+    export_dataset_iterator(ListDataSetIterator([DataSet(x, None)],
+                                                batch_size=4),
+                            str(tmp_path / "unl"))
+    ds = next(iter(ShardedFileDataSetIterator(str(tmp_path / "unl"))))
+    np.testing.assert_allclose(ds.features, x)
+    assert ds.labels is None
+
+    y = [np.zeros((4, 2), np.float32), np.ones((4, 1), np.float32)]
+    m = [None, np.ones((4,), np.float32)]
+    export_dataset_iterator(
+        ListDataSetIterator([DataSet([x, x], y, None, m)], batch_size=4),
+        str(tmp_path / "holes"))
+    ds2 = next(iter(ShardedFileDataSetIterator(str(tmp_path / "holes"))))
+    assert isinstance(ds2.labels_mask, list) and len(ds2.labels_mask) == 2
+    assert ds2.labels_mask[0] is None
+    np.testing.assert_allclose(ds2.labels_mask[1], m[1])

@@ -160,6 +160,13 @@ class ShardedFileDataSetIterator(DataSetIterator):
                 if m:
                     out[int(m.group(1))] = z[k]
             return out
+        # legacy shards (written before the _len marker) carry only the
+        # _inJ parts — reassemble in index order
+        parts = sorted((k for k in z.files
+                        if re.fullmatch(re.escape(name) + r"_in\d+", k)),
+                       key=lambda k: int(k.rsplit("_in", 1)[1]))
+        if parts:
+            return [z[k] for k in parts]
         return None
 
     def __iter__(self) -> Iterator[DataSet]:
@@ -170,7 +177,8 @@ class ShardedFileDataSetIterator(DataSetIterator):
             with np.load(os.path.join(self.data_dir, fname)) as z:
                 n = 0
                 while (f"features_{n}" in z.files
-                       or f"features_{n}_len" in z.files):
+                       or f"features_{n}_len" in z.files
+                       or f"features_{n}_in0" in z.files):   # legacy shards
                     n += 1
                 for i in range(n):
                     yield DataSet(

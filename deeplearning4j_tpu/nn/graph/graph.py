@@ -197,6 +197,9 @@ class ComputationGraph:
             acts, new_state, rnn_out = res
         else:
             acts, new_state = res
+        cd = getattr(self.conf, "compute_dtype", None)
+        if cd:
+            from ..multilayer import cast_floats
         total = 0.0
         for k, out_name in enumerate(self.conf.network_outputs):
             vi = self.vertex_names.index(out_name)
@@ -218,10 +221,8 @@ class ComputationGraph:
             if v.preprocessor is not None:
                 feed = v.preprocessor.apply(feed)
             rng, sub = jax.random.split(rng)
-            cd = getattr(self.conf, "compute_dtype", None)
             head_params = params[vi]
             if cd:
-                from ..multilayer import cast_floats
                 head_params = cast_floats(head_params, cd)
                 feed = cast_floats(feed, cd)
             per_ex = v.layer_conf.compute_loss_per_example(

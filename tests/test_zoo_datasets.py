@@ -445,3 +445,17 @@ def test_export_none_labels_and_none_holes(tmp_path):
     assert isinstance(ds2.labels_mask, list) and len(ds2.labels_mask) == 2
     assert ds2.labels_mask[0] is None
     np.testing.assert_allclose(ds2.labels_mask[1], m[1])
+
+
+def test_sharded_iterator_reads_legacy_multi_input_shards(tmp_path):
+    """Shards written before the _len marker (bare _inJ parts) still read."""
+    from deeplearning4j_tpu.datasets import ShardedFileDataSetIterator
+    d = tmp_path / "legacy"
+    d.mkdir()
+    np.savez(str(d / "shard_00000.npz"),
+             features_0_in0=np.ones((2, 3), np.float32),
+             features_0_in1=np.full((2, 5), 2.0, np.float32),
+             labels_0=np.zeros((2, 2), np.float32))
+    ds = next(iter(ShardedFileDataSetIterator(str(d))))
+    assert isinstance(ds.features, list) and len(ds.features) == 2
+    np.testing.assert_allclose(ds.features[1], 2.0)

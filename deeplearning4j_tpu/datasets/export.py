@@ -161,12 +161,18 @@ class ShardedFileDataSetIterator(DataSetIterator):
                     out[int(m.group(1))] = z[k]
             return out
         # legacy shards (written before the _len marker) carry only the
-        # _inJ parts — reassemble in index order
-        parts = sorted((k for k in z.files
-                        if re.fullmatch(re.escape(name) + r"_in\d+", k)),
-                       key=lambda k: int(k.rsplit("_in", 1)[1]))
-        if parts:
-            return [z[k] for k in parts]
+        # _inJ parts — place each at its parsed index (length = max index
+        # + 1) so None holes below the highest index survive
+        indexed = {}
+        for k in z.files:
+            m = re.fullmatch(re.escape(name) + r"_in(\d+)", k)
+            if m:
+                indexed[int(m.group(1))] = z[k]
+        if indexed:
+            out = [None] * (max(indexed) + 1)
+            for j, v in indexed.items():
+                out[j] = v
+            return out
         return None
 
     def __iter__(self) -> Iterator[DataSet]:
@@ -178,7 +184,8 @@ class ShardedFileDataSetIterator(DataSetIterator):
                 n = 0
                 while (f"features_{n}" in z.files
                        or f"features_{n}_len" in z.files
-                       or f"features_{n}_in0" in z.files):   # legacy shards
+                       or any(k.startswith(f"features_{n}_in")
+                              for k in z.files)):            # legacy shards
                     n += 1
                 for i in range(n):
                     yield DataSet(

@@ -172,3 +172,23 @@ def test_amp_outputs_are_master_dtype_and_bn_stats_full_precision():
     a, f = np.asarray(s_amp[1]["mean"]), np.asarray(s_full[1]["mean"])
     denom = np.maximum(np.abs(f), 1e-3)
     assert float((np.abs(a - f) / denom).mean()) < 0.02, (a, f)
+
+
+def test_amp_composes_with_parallel_wrapper():
+    """AMP + per-step psum DP on the 8-device mesh: f32 masters replicated,
+    bf16 compute, training improves."""
+    from deeplearning4j_tpu.datasets import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+    conf = (NeuralNetConfiguration(seed=13, updater=Adam(5e-3),
+                                   dtype="float32", compute_dtype="bfloat16")
+            .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = _xor_data(128)
+    s0 = net.score(x, y)
+    pw = ParallelWrapper(net, workers=8, training_mode="shared_gradients")
+    pw.fit(ListDataSetIterator(features=x, labels=y, batch_size=64), epochs=15)
+    assert net.score(x, y) < s0
+    assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())

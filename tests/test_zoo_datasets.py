@@ -459,3 +459,21 @@ def test_sharded_iterator_reads_legacy_multi_input_shards(tmp_path):
     ds = next(iter(ShardedFileDataSetIterator(str(d))))
     assert isinstance(ds.features, list) and len(ds.features) == 2
     np.testing.assert_allclose(ds.features[1], 2.0)
+
+
+def test_legacy_shard_none_hole_positions_survive(tmp_path):
+    """Legacy shards encode None holes by ABSENCE of an index: the reader
+    reconstructs parts at their parsed positions."""
+    from deeplearning4j_tpu.datasets import ShardedFileDataSetIterator
+    d = tmp_path / "legacy2"
+    d.mkdir()
+    np.savez(str(d / "shard_00000.npz"),
+             features_0=np.ones((2, 3), np.float32),
+             labels_0_in0=np.zeros((2, 2), np.float32),
+             labels_0_in1=np.ones((2, 1), np.float32),
+             labels_mask_0_in1=np.ones((2,), np.float32))  # hole at 0
+    ds = next(iter(ShardedFileDataSetIterator(str(d))))
+    assert isinstance(ds.labels_mask, list) and len(ds.labels_mask) == 2
+    assert ds.labels_mask[0] is None
+    np.testing.assert_allclose(ds.labels_mask[1], 1.0)
+

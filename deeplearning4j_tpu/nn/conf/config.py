@@ -110,6 +110,14 @@ class NeuralNetConfiguration:
         self.optimization_algorithm = optimization_algorithm.lower()
         self.max_num_line_search_iterations = max_num_line_search_iterations
         self.gradient_checkpointing = gradient_checkpointing
+        if compute_dtype is not None:
+            import jax.numpy as jnp
+            try:
+                jnp.dtype(compute_dtype)
+            except TypeError as e:
+                raise ValueError(
+                    f"Unknown compute_dtype {compute_dtype!r} (expected a "
+                    f"dtype name like 'bfloat16' or 'float32')") from e
         self.compute_dtype = compute_dtype
 
     # --- cascade (reference :604-608): fill None fields from globals ---

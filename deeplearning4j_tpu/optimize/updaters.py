@@ -264,7 +264,11 @@ UPDATER_BY_NAME = {
 
 
 def updater_from_name(name, lr=0.1):
-    cls = UPDATER_BY_NAME[str(name).lower()]
+    key = str(name).lower()
+    if key not in UPDATER_BY_NAME:
+        raise ValueError(f"Unknown updater {name!r}; available: "
+                         f"{sorted(UPDATER_BY_NAME)}")
+    cls = UPDATER_BY_NAME[key]
     try:
         return cls(learning_rate=lr)
     except TypeError:

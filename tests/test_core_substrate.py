@@ -103,3 +103,13 @@ def test_cascade_defaults():
     assert conf.layers[1].activation == "softmax"  # per-layer override wins
     assert conf.layers[0].l2 == 0.5
     assert conf.layers[0].weight_init == "relu"
+
+
+def test_unknown_updater_and_compute_dtype_fail_clearly():
+    """Misconfigurations fail at build time naming the alternatives, not as
+    opaque KeyError/dtype traces at first use."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    with pytest.raises(ValueError, match="adamm"):
+        NeuralNetConfiguration(seed=1, updater="adamm")
+    with pytest.raises(ValueError, match="bf17"):
+        NeuralNetConfiguration(seed=1, compute_dtype="bf17")

@@ -192,3 +192,22 @@ def test_amp_composes_with_parallel_wrapper():
     pw.fit(ListDataSetIterator(features=x, labels=y, batch_size=64), epochs=15)
     assert net.score(x, y) < s0
     assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())
+
+
+def test_amp_tbptt_trains():
+    """tBPTT chunked training under AMP: rnn carries cross chunk boundaries
+    at master precision, loss decreases."""
+    conf = (NeuralNetConfiguration(seed=17, updater=Adam(5e-3),
+                                   dtype="float32", compute_dtype="bfloat16")
+            .list(LSTM(n_out=16, activation="tanh"),
+                  RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 12))
+            .tbptt_length(4).build())
+    net = MultiLayerNetwork(conf).init()
+    ids = R.integers(0, 4, (8, 12))
+    x = np.eye(4, dtype=np.float32)[ids]
+    y = np.eye(4, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=8, batch_size=8)
+    assert net.score(x, y) < s0
+    assert all(v.dtype == jnp.float32 for p in net.params for v in p.values())

@@ -1,51 +1,78 @@
-"""Benchmarks for the BASELINE.md configs.
+"""Benchmarks for the BASELINE.md configs — SELF-SANITIZING.
 
 Headline (the ONE JSON line printed to stdout, consumed by the driver):
 ResNet-50 ImageNet-shape training throughput, img/sec/chip, f32 224x224
 (BASELINE #2), vs an independent flax.linen+optax ResNet-50 on the same
 device/batch/dtype — target >= 0.70x (vs_baseline = ours/reference).
 
+Measurement integrity contract (round-4; BENCH_r03 shipped an AMP row at
+937% MFU — the tunnel's lazy-completion artifact — so every number is now
+checked in code, not prose):
+  1. Every throughput row with a known per-step FLOP count is checked
+     against the MXU roofline: implied MFU must be <= BENCH_MAX_PLAUSIBLE_MFU
+     (default 0.60 — our best honest row is ~0.30).
+  2. A chained-timing row that violates the roofline is RE-MEASURED with the
+     device-slope method (n steps inside one jitted fori_loop, two n values
+     differenced — immune to per-call transport artifacts).
+  3. If the re-measure still violates the roofline, the row is published as
+     {"value": null, "estimate": <roofline upper bound>, "invalid_reason": ...}
+     — an impossible number is never printed as a value.
+  4. Sub-ms measured times are cross-checked against the HBM floor
+     (bytes_accessed / BENCH_HBM_GBPS); a "measurement" faster than memory
+     allows is replaced by the bandwidth-bound estimate, labeled as such.
+  5. _loop_slope_time asserts a positive slope (transport jitter can make
+     the larger-n window time faster); it retries with more differenced
+     work and raises BenchImplausible rather than returning a negative or
+     infinite throughput.
+
 The same line carries an ``extras`` dict with the remaining BASELINE rows:
   - resnet50_bf16_img_per_sec      ResNet-50, bfloat16 params+data, batch>=128
   - resnet50_bf16_flax_img_per_sec independent flax ResNet-50, same bf16/batch
   - resnet50_amp_img_per_sec       mixed precision: f32 master params +
                                    bf16 compute (compute_dtype), batch 128
+  - resnet50_piped_img_per_sec     same AMP step fed from the export-shard
+                                   pipeline via AsyncDataSetIterator
+                                   (host->device transfer included: the ETL
+                                   discipline of PerformanceListener.java)
   - resnet50_bf16_vs_flax_bf16     apples-to-apples bf16 ratio (ours/flax)
-  - mfu                            achieved TFLOP/s + MFU for ResNet f32/bf16
-                                   and the LSTM, from XLA's compiled-program
-                                   cost analysis over measured step time,
-                                   against the chip's bf16 peak (v5e: 197
-                                   TFLOP/s; override BENCH_PEAK_TFLOPS)
+  - mfu                            achieved TFLOP/s + MFU for valid rows,
+                                   from XLA's compiled-program cost analysis
+                                   over measured step time, against the
+                                   chip's bf16 peak (v5e: 197 TFLOP/s;
+                                   override BENCH_PEAK_TFLOPS)
   - lstm_train_tokens_per_sec      GravesLSTM char-RNN (BASELINE #3)
   - lstm_plain_tokens_per_sec      plain (no-peephole) LSTM, same shapes —
-                                   rides the fused Pallas cell (ops/
-                                   pallas_lstm.py) when applicable
+                                   rides the fused Pallas cell
   - lstm_reference_tokens_per_sec  independent flax OptimizedLSTMCell char-RNN
   - lstm_vs_reference              plain / reference (apples-to-apples ratio)
     All three LSTM rows use DEVICE-slope timing (_loop_slope_time): the
     ~ms-scale per-call tunnel dispatch floor would otherwise swamp the
-    ~0.2ms step and compress any real ratio toward 1.0 (round-3 change;
-    r02 numbers were host-chained and transport-dominated).
+    ~0.2ms step and compress any real ratio toward 1.0.
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
-                                   #4), gated on a measured loss decrease on a
-                                   held probe batch (quality gate)
+                                   #4), gated on (a) a probe-loss decrease
+                                   with a margin far above noise and (b) a
+                                   similarity probe: trained pairs must be
+                                   measurably closer than random pairs
   - collective_overhead_by_mesh    per-step overhead of psum sync-DP on 1/2/
                                    4/8-device virtual CPU meshes (BASELINE #5;
                                    chips unavailable, so this measures mesh +
-                                   collective dispatch overhead, not ICI)
+                                   collective dispatch overhead, not ICI);
+                                   best-of-repeats per point (single-shot was
+                                   noise at mesh 4/8 in r3)
   - threshold_encode_ms_25m        {topk_ms, dense_est_ms, dense_note}:
                                    bounded-payload top-k encode+decode
-                                   (measured) vs the dense reference-
-                                   semantics encoder (bandwidth-bound
-                                   cost-analysis estimate), both on a
+                                   (measured, HBM-floor-checked) vs the dense
+                                   reference-semantics encoder (bandwidth-
+                                   bound cost-analysis estimate), both on a
                                    25M-param flat gradient (DCN codec cost)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
-BENCH_BUDGET_S, BENCH_PEAK_TFLOPS, BENCH_REPEATS (timed windows per bench,
-best-of; default 3).
+BENCH_BUDGET_S, BENCH_PEAK_TFLOPS, BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU,
+BENCH_REPEATS (timed windows per bench, best-of; default 3).
 """
 import functools
 import json
+import math
 import os
 import subprocess
 import sys
@@ -58,8 +85,42 @@ IMG = int(os.environ.get("BENCH_IMG", "224"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 WARMUP = 3
 
-
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+# v5e bf16 MXU peak. f32 matmuls/convs at JAX's DEFAULT precision also run
+# as single bf16 MXU passes on TPU, so the same peak is the honest
+# denominator for both dtypes here.
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197.0"))
+HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", "819"))
+# Plausibility ceiling: our best honest ResNet row is ~30% MFU; anything
+# above 60% on this stack is a measurement artifact, not a speedup.
+MAX_PLAUSIBLE_MFU = float(os.environ.get("BENCH_MAX_PLAUSIBLE_MFU", "0.6"))
+
+
+class BenchImplausible(RuntimeError):
+    """A timing that no physically possible execution could produce."""
+
+
+def _implied_mfu(flops_per_step, dt):
+    """MFU implied by a measured per-step time (None if flops unknown)."""
+    if not flops_per_step or not dt or dt <= 0:
+        return None
+    return flops_per_step / dt / 1e12 / PEAK_TFLOPS
+
+
+def _roofline_dt(flops_per_step):
+    """Fastest physically plausible per-step time at the MFU ceiling."""
+    return flops_per_step / (PEAK_TFLOPS * 1e12 * MAX_PLAUSIBLE_MFU)
+
+
+def _invalid_row(items_per_step, flops_per_step, reason):
+    """The null row contract: never publish an impossible number."""
+    est = None
+    if flops_per_step:
+        est = round(items_per_step / _roofline_dt(flops_per_step), 2)
+    return {"value": None, "invalid_reason": reason,
+            "estimate": est,
+            "estimate_kind": f"roofline_upper_bound@{MAX_PLAUSIBLE_MFU:.0%}_mfu"}
 
 
 def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
@@ -76,6 +137,11 @@ def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
     cached result for a repeated identical request. The n values are large
     enough that the differenced device work (hundreds of ms) dominates the
     tunnel's multi-ms call-time jitter.
+
+    Raises BenchImplausible if the slope is non-positive after a retry with
+    4x the differenced work (transport jitter can make the larger-n window
+    time faster; silently returning a negative per-step time would surface
+    as negative/infinite throughput in a headline row).
     """
     import jax
     import jax.numpy as jnp
@@ -89,21 +155,30 @@ def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
             return jax.lax.fori_loop(0, n, lambda k, a: step_fn(xs, a), st)
         return many
 
-    times = []
     salt = 0.0
-    for n in n_pair:
-        f = make(n)
-        out = f(0.0, x, state)
-        jax.block_until_ready(out)
-        best = float("inf")
-        for _ in range(REPEATS):
-            salt += 1.0
-            t0 = time.perf_counter()
-            out = f(salt, x, state)
+    for attempt in range(2):
+        times = []
+        for n in n_pair:
+            f = make(n)
+            out = f(0.0, x, state)
             jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t0)
-        times.append(best)
-    return (times[1] - times[0]) / (n_pair[1] - n_pair[0])
+            best = float("inf")
+            for _ in range(REPEATS):
+                salt += 1.0
+                t0 = time.perf_counter()
+                out = f(salt, x, state)
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
+            times.append(best)
+        slope = (times[1] - times[0]) / (n_pair[1] - n_pair[0])
+        if slope > 0:
+            return slope
+        print(f"[bench] non-positive slope {slope:.3g} at n_pair={n_pair}; "
+              f"retrying with 4x work", file=sys.stderr)
+        n_pair = (n_pair[0] * 4, n_pair[1] * 4)
+    raise BenchImplausible(
+        f"non-positive device-time slope after retry (times={times}, "
+        f"n_pair={n_pair}): transport jitter exceeded differenced work")
 
 
 def _time_steps(step_fn, args, steps):
@@ -126,12 +201,6 @@ def _time_steps(step_fn, args, steps):
     return best / steps
 
 
-# v5e bf16 MXU peak. f32 matmuls/convs at JAX's DEFAULT precision also run
-# as single bf16 MXU passes on TPU, so the same peak is the honest
-# denominator for both dtypes here.
-PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197.0"))
-
-
 def _aot(jitted, args):
     """AOT-compile a jitted step once and pull XLA's flop estimate for the
     whole training step from the compiled executable's cost analysis.
@@ -150,7 +219,87 @@ def _aot(jitted, args):
         return jitted, None
 
 
-def bench_ours(dtype="float32", batch=None, img=None, compute_dtype=None):
+def _guarded_rate(step_xc, x, carry, *, items_per_step, label, steps=STEPS):
+    """Measure items/sec for a (x, carry)->carry training step with the
+    roofline self-check. Chained timing first (cheap, correct for >=50ms
+    steps); on a roofline violation re-measure with the device-slope
+    method; if STILL impossible, return the null row.
+
+    Returns (row, dt, flops): row is a float (valid) or the invalid-row
+    dict; dt/flops feed the MFU table (dt None when the row is invalid).
+    """
+    import jax
+
+    jitted = jax.jit(step_xc, donate_argnums=(1,))
+    runner, flops = _aot(jitted, [x, carry])
+
+    state = carry
+    for _ in range(WARMUP):
+        state = runner(x, state)
+    jax.block_until_ready(state)
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = runner(x, state)
+        jax.block_until_ready(state)
+        best = min(best, time.perf_counter() - t0)
+    dt = best / steps
+
+    mfu = _implied_mfu(flops, dt)
+    if mfu is None or mfu <= MAX_PLAUSIBLE_MFU:
+        return items_per_step / dt, dt, flops
+
+    # Chained timing produced a physically impossible number (the tunnel's
+    # lazy-completion artifact) — re-measure with the slope method, sizing
+    # n so the differenced work is >= ~2s at the fastest plausible speed.
+    print(f"[bench] {label}: chained timing implies {mfu:.1%} MFU "
+          f"(> {MAX_PLAUSIBLE_MFU:.0%} ceiling) — re-measuring via device "
+          f"slope", file=sys.stderr)
+    dt_floor = _roofline_dt(flops)
+    n0 = max(2, min(64, math.ceil(1.0 / dt_floor)))
+    try:
+        dt = _loop_slope_time(step_xc, (x, state), n_pair=(n0, 3 * n0))
+    except BenchImplausible as e:
+        return _invalid_row(items_per_step, flops, str(e)), None, flops
+    mfu = _implied_mfu(flops, dt)
+    if mfu is not None and mfu > MAX_PLAUSIBLE_MFU:
+        return (_invalid_row(
+            items_per_step, flops,
+            f"slope re-measure still implies {mfu:.1%} MFU "
+            f"(> {MAX_PLAUSIBLE_MFU:.0%} plausibility ceiling)"),
+            None, flops)
+    print(f"[bench] {label}: slope re-measure OK ({mfu:.1%} MFU)",
+          file=sys.stderr)
+    return items_per_step / dt, dt, flops
+
+
+def _slope_rate_guarded(step_xc, x, carry, *, items_per_step, flops, label,
+                        n_pair=(64, 576)):
+    """Slope-timed rate with the same roofline contract (for sub-ms steps
+    where chained timing is transport-dominated from the start)."""
+    try:
+        dt = _loop_slope_time(step_xc, (x, carry), n_pair=n_pair)
+    except BenchImplausible as e:
+        return _invalid_row(items_per_step, flops, str(e)), None
+    mfu = _implied_mfu(flops, dt)
+    if mfu is not None and mfu > MAX_PLAUSIBLE_MFU:
+        return (_invalid_row(
+            items_per_step, flops,
+            f"device-slope timing implies {mfu:.1%} MFU "
+            f"(> {MAX_PLAUSIBLE_MFU:.0%} plausibility ceiling)"), None)
+    return items_per_step / dt, dt
+
+
+def _rowval(row):
+    """The numeric value of a row that may be a float or an invalid-dict."""
+    if isinstance(row, dict):
+        return row.get("value")
+    return row
+
+
+def bench_ours(dtype="float32", batch=None, img=None, compute_dtype=None,
+               label="resnet50"):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.zoo import resnet50
@@ -166,19 +315,19 @@ def bench_ours(dtype="float32", batch=None, img=None, compute_dtype=None):
     x = jnp.asarray(rng.normal(size=(batch, img, img, 3)), jdt)
     y = jnp.asarray(np.eye(1000)[rng.integers(0, 1000, batch)], jdt)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 2))
-    def step(params, state, opt_state, it, key):
+    def step(xs, carry):
+        params, state, opt_state, it, key = carry
         def lf(p):
-            return net.loss_fn(p, state, x, y, train=True, rng=key)
+            return net.loss_fn(p, state, xs, y, train=True, rng=key)
         (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
         new_params, new_opt = net.updater.update(grads, opt_state, params, it)
         return new_params, new_state, new_opt, it + 1, key
 
-    args = [net.params, net.state, net.opt_state,
-            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)]
-    runner, flops = _aot(step, args)
-    dt = _time_steps(runner, args, STEPS)
-    return batch / dt, flops
+    carry = (net.params, net.state, net.opt_state,
+             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    row, dt, flops = _guarded_rate(step, x, carry, items_per_step=batch,
+                                   label=label)
+    return row, dt, flops
 
 
 def bench_reference(dtype="float32", batch=None):
@@ -244,11 +393,11 @@ def bench_reference(dtype="float32", batch=None):
     tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
     opt_state = tx.init(params)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 2))
-    def step(params, batch_stats, opt_state):
+    def step(xs, carry):
+        params, batch_stats, opt_state = carry
         def lf(p):
             logits, mut = model.apply({"params": p, "batch_stats": batch_stats},
-                                      x, train=True, mutable=["batch_stats"])
+                                      xs, train=True, mutable=["batch_stats"])
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
             return loss, mut["batch_stats"]
@@ -256,10 +405,125 @@ def bench_reference(dtype="float32", batch=None):
         updates, new_opt = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_bs, new_opt
 
-    args = [params, batch_stats, opt_state]
-    runner, flops = _aot(step, args)
-    dt = _time_steps(runner, args, STEPS)
-    return batch / dt, flops
+    carry = (params, batch_stats, opt_state)
+    row, dt, flops = _guarded_rate(step, x, carry, items_per_step=batch,
+                                   label=f"resnet50_flax_{dtype}")
+    return row, dt, flops
+
+
+def bench_piped(batch=128):
+    """The ETL-fed row (reference PerformanceListener.java:111,178 measures
+    ETL time per iteration; MultiLayerNetwork.java:1130 feeds it): the same
+    AMP training step, but each step's batch comes from the export-shard
+    pipeline through AsyncDataSetIterator — uint8 NHWC shards read from
+    disk, prefetched on a background thread, shipped host->device and
+    normalized ON DEVICE inside the measured window (uint8 transfer +
+    on-device /255 is the TPU-first input path: 4x less wire traffic than
+    shipping f32). Reports piped img/s beside the device-resident AMP row
+    so the pipeline tax is a measured number, not a claim — plus the
+    measured host->device bandwidth so a transport-limited gap is
+    attributed, not hidden (this rig reaches the chip through a tunnel).
+
+    Timing is plain chained wall-clock over whole epochs (the host feed is
+    the thing under test; each step is ~50ms of device work, far above the
+    tunnel's dispatch floor) — with the same roofline guard as every row."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.datasets.dataset import AsyncDataSetIterator, DataSet
+    from deeplearning4j_tpu.datasets.export import (ShardedFileDataSetIterator,
+                                                    export_dataset_iterator)
+    from deeplearning4j_tpu.models.zoo import resnet50
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+    img = IMG
+    n_batches = 12
+    rng = np.random.default_rng(0)
+
+    net = resnet50(n_classes=1000, height=img, width=img, channels=3,
+                   updater=Nesterovs(0.1, momentum=0.9), dtype="float32",
+                   compute_dtype="bfloat16").init()
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(params, state, opt_state, it, key, x_u8, y_idx):
+        x = x_u8.astype(jnp.float32) / 255.0     # normalize on device
+        y = jax.nn.one_hot(y_idx, 1000, dtype=jnp.float32)
+        def lf(p):
+            return net.loss_fn(p, state, x, y, train=True, rng=key)
+        (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+        return new_params, new_state, new_opt, it + 1, key
+
+    # flop count for the roofline check (lowered BEFORE timing: the timed
+    # loop donates the param buffers)
+    try:
+        x0 = jnp.zeros((batch, img, img, 3), jnp.uint8)
+        y0 = jnp.zeros((batch,), jnp.int32)
+        _, flops = _aot(step, [net.params, net.state, net.opt_state,
+                               jnp.asarray(0, jnp.int32),
+                               jax.random.PRNGKey(0), x0, y0])
+    except Exception:
+        flops = None
+
+    # measured host->device bandwidth (for gap attribution); the buffer is
+    # salted per call — the tunnel serves repeated IDENTICAL requests from
+    # a cache (see _loop_slope_time), which would fake the bandwidth
+    buf = np.zeros((batch, img, img, 3), np.uint8)
+    jax.block_until_ready(jax.device_put(buf))
+    bw_best = float("inf")
+    for salt in range(1, 4):
+        buf[0, 0, 0, 0] = salt
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(buf))
+        bw_best = min(bw_best, time.perf_counter() - t0)
+    h2d_gbps = buf.nbytes / bw_best / 1e9
+
+    with tempfile.TemporaryDirectory() as d:
+        # write the shard files once (the Spark master's export path)
+        def gen():
+            for _ in range(n_batches):
+                x = rng.integers(0, 256, (batch, img, img, 3)).astype(np.uint8)
+                y = rng.integers(0, 1000, (batch,)).astype(np.int32)
+                yield DataSet(x, y)
+        export_dataset_iterator(gen(), d, batches_per_shard=2)
+
+        carry = [net.params, net.state, net.opt_state,
+                 jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)]
+
+        def run_epoch(carry):
+            it = AsyncDataSetIterator(ShardedFileDataSetIterator(d),
+                                      queue_size=4)
+            n = 0
+            for ds in it:
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                carry = list(step(*carry, x, y))
+                n += 1
+            jax.block_until_ready(carry)
+            return n, carry
+
+        n, carry = run_epoch(carry)   # warmup epoch: compile + page cache
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            n, carry = run_epoch(carry)
+            best = min(best, time.perf_counter() - t0)
+        dt = best / n
+
+    # roofline-check against the AMP step's flop count
+    mfu = _implied_mfu(flops, dt)
+    if mfu is not None and mfu > MAX_PLAUSIBLE_MFU:
+        return _invalid_row(batch, flops,
+                            f"piped timing implies {mfu:.1%} MFU"), None, flops
+    row = {"value": round(batch / dt, 2),
+           "host_to_device_gbps": round(h2d_gbps, 3),
+           "transfer_floor_ms": round(buf.nbytes / (h2d_gbps * 1e9) * 1e3, 2),
+           "note": ("uint8 wire format, on-device normalize; gap vs the "
+                    "resident AMP row is attributable to the measured "
+                    "host->device path (tunnel-limited on this rig) when "
+                    "transfer_floor_ms exceeds the resident step time")}
+    return row, dt, flops
 
 
 def bench_lstm(cell: str = "graves"):
@@ -299,8 +563,9 @@ def bench_lstm(cell: str = "graves"):
     _, flops = _aot(jax.jit(step), [x, carry])
     # device-slope timing: the LSTM step is ~0.2ms of device work, far below
     # the tunnel's per-call dispatch floor — see _loop_slope_time
-    dt = _loop_slope_time(step, (x, carry))
-    return B * T / dt, flops
+    row, dt = _slope_rate_guarded(step, x, carry, items_per_step=B * T,
+                                  flops=flops, label=f"lstm_{cell}")
+    return row, dt, flops
 
 
 def bench_lstm_reference():
@@ -340,17 +605,24 @@ def bench_lstm_reference():
         updates, new_opt = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
+    _, flops = _aot(jax.jit(step), [x, (params, opt_state)])
     # same device-slope method as bench_lstm for an apples-to-apples ratio
-    dt = _loop_slope_time(step, (x, (params, opt_state)))
-    return B * T / dt
+    row, _ = _slope_rate_guarded(step, x, (params, opt_state),
+                                 items_per_step=B * T, flops=flops,
+                                 label="lstm_flax")
+    return row
 
 
 def bench_word2vec():
     """SkipGram negative-sampling jitted step, words(centers)/sec
     (BASELINE #4: large embedding table). The throughput number is tied to
-    a quality gate: after the timed steps the SGNS probe loss on the
-    training pairs (fresh negatives) must have decreased, so a silent
-    correctness regression can't hide behind a fast step."""
+    TWO quality gates so a silently broken update can't hide behind a fast
+    step (r3's gate passed on a 0.0008 loss delta — vacuous):
+      (a) 200 optimizer steps from scratch must cut the probe loss by a
+          margin (>= 0.1 nats) far above measurement noise, and
+      (b) a similarity probe: mean cosine(syn0[center], syn1[context]) over
+          the trained pairs must exceed the same statistic over random
+          pairs by >= 0.1 — the actual semantic contract of SGNS."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.nlp.sequence_vectors import (_sgns_grads,
@@ -382,32 +654,59 @@ def bench_word2vec():
 
     # device-slope timing: the SGNS step is well under the tunnel's per-call
     # dispatch floor (see _loop_slope_time)
-    dt = _loop_slope_time(wrapped,
-                          (jnp.zeros((8, 128), jnp.float32),
-                           (syn0, syn1, key)))
+    zero_salt = jnp.zeros((8, 128), jnp.float32)
+    row, _ = _slope_rate_guarded(wrapped, zero_salt, (syn0, syn1, key),
+                                 items_per_step=B, flops=None,
+                                 label="word2vec")
+    if isinstance(row, dict):
+        return row
 
-    # the quality gate: a few more optimizer steps from scratch must
-    # strictly reduce the probe loss
+    # quality gate (a): 200 steps from scratch, loss margin >= 0.1
     s0 = jnp.asarray(rng.normal(size=(V, D)).astype(np.float32) * 0.01)
     s1, k = jnp.zeros((V, D), jnp.float32), jax.random.PRNGKey(7)
-    zero_salt = jnp.zeros((8, 128), jnp.float32)
-    for _ in range(10):
-        s0, s1, k = wrapped(zero_salt, (s0, s1, k))
+
+    @jax.jit
+    def train_n(carry):
+        return jax.lax.fori_loop(0, 200,
+                                 lambda i, c: wrapped(zero_salt, c), carry)
+
+    s0, s1, k = train_n((s0, s1, k))
     loss_after = float(probe_loss(s0, s1))
-    if not loss_after < loss_before:
+    margin = 0.1
+    if not loss_after < loss_before - margin:
         raise RuntimeError(
             f"word2vec quality gate FAILED: probe loss {loss_before:.4f} -> "
-            f"{loss_after:.4f} did not decrease")
-    return {"words_per_sec": round(B / dt, 3),
+            f"{loss_after:.4f}; needs a decrease >= {margin} (noise floor)")
+
+    # quality gate (b): trained pairs must be closer than random pairs
+    @jax.jit
+    def pair_cosine(s0, s1, a, b):
+        va, vb = s0[a], s1[b]
+        na = jnp.linalg.norm(va, axis=1) + 1e-9
+        nb = jnp.linalg.norm(vb, axis=1) + 1e-9
+        return jnp.mean(jnp.sum(va * vb, axis=1) / (na * nb))
+    trained_cos = float(pair_cosine(s0, s1, centers, contexts))
+    rand_cos = float(pair_cosine(
+        s0, s1, jnp.asarray(rng.integers(0, V, (B,))),
+        jnp.asarray(rng.integers(0, V, (B,)))))
+    if not trained_cos > rand_cos + 0.1:
+        raise RuntimeError(
+            f"word2vec similarity gate FAILED: trained-pair cosine "
+            f"{trained_cos:.3f} vs random {rand_cos:.3f}")
+    return {"words_per_sec": round(row, 3),
             "probe_loss_before": round(loss_before, 4),
-            "probe_loss_after": round(loss_after, 4), "gate": "ok"}
+            "probe_loss_after": round(loss_after, 4),
+            "trained_pair_cosine": round(trained_cos, 3),
+            "random_pair_cosine": round(rand_cos, 3), "gate": "ok"}
 
 
 def bench_threshold_encode():
     """Encode(+decode) ms on a 25M-element flat gradient (ResNet-50 scale):
     the bounded-payload top-k format (the ~90ms top_k cost) AND the dense
     reference-semantics encoder (elementwise; what EncodedAccumulator uses
-    by default)."""
+    by default). The measured top-k time is checked against the HBM floor —
+    a 'measurement' faster than memory bandwidth allows is replaced by the
+    cost-analysis estimate, labeled as such."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.ops.compression import (threshold_encode_dense,
@@ -424,20 +723,37 @@ def bench_threshold_encode():
         return (new_res,)
 
     dt = _time_steps(step, [g], max(5, STEPS // 2))
+    out = {}
+
+    # HBM floor for the roundtrip (reads+writes >= 2 passes over 100MB)
+    try:
+        compiled = jax.jit(lambda r: threshold_roundtrip(
+            r, threshold=1e-3, capacity=n // 100)[1]).lower(g).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        floor_s = float(ca.get("bytes accessed", 2e8)) / (HBM_GBPS * 1e9)
+    except Exception:
+        floor_s = 2e8 / (HBM_GBPS * 1e9)
+    if dt < floor_s:
+        out["topk_ms"] = None
+        out["topk_est_ms"] = round(floor_s * 1e3, 3)
+        out["topk_note"] = (f"measured {dt*1e3:.3f}ms is below the HBM floor "
+                            f"{floor_s*1e3:.3f}ms (lazy-completion artifact); "
+                            "bandwidth-bound estimate reported instead")
+    else:
+        out["topk_ms"] = round(dt * 1e3, 3)
 
     # The dense encoder is a single fused elementwise pass; its ~0.25ms is
     # far below every transport artifact on this rig (slope AND chained
     # timings both read ~0 — not credible), so report a bandwidth-bound
     # ESTIMATE from XLA's compiled cost analysis instead of a fake
     # measurement: bytes-accessed / HBM bandwidth (v5e ~819 GB/s).
-    out = {"topk_ms": round(dt * 1e3, 3)}
     try:
         compiled = jax.jit(
             lambda r: threshold_encode_dense(r, 1e-3)[1]).lower(g).compile()
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        hbm_gbps = float(os.environ.get("BENCH_HBM_GBPS", "819"))
-        dense_est = float(ca.get("bytes accessed", 2e8)) / (hbm_gbps * 1e9)
+        dense_est = float(ca.get("bytes accessed", 2e8)) / (HBM_GBPS * 1e9)
         out["dense_est_ms"] = round(dense_est * 1e3, 3)
         out["dense_note"] = ("estimate = bytes_accessed / HBM bandwidth "
                              "(elementwise op, unmeasurably fast vs "
@@ -456,8 +772,9 @@ def bench_collective_overhead():
     the psum gradient sync and the identical step without it, at a FIXED
     per-device shard of 25M/8 elements — weak scaling, so the global
     gradient is ndev*25M/8 and reaches ResNet-50 size (25M) on the 8-device
-    mesh). Runs in a subprocess so the CPU platform doesn't poison this
-    process."""
+    mesh). Best-of-5 windows per point (r3 shipped single-shot numbers that
+    were non-monotonic noise at mesh 4/8). Runs in a subprocess so the CPU
+    platform doesn't poison this process."""
     code = r"""
 import json, time, functools
 import numpy as np
@@ -482,17 +799,21 @@ for ndev in (1, 2, 4, 8):
 
     def t(f):
         r = f(g); jax.block_until_ready(r)
-        t0 = time.perf_counter()
-        for _ in range(10):
-            r = f(g)
-        jax.block_until_ready(r)
-        return (time.perf_counter() - t0) / 10 * 1e3
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = f(g)
+            jax.block_until_ready(r)
+            best = min(best, time.perf_counter() - t0)
+        return best / 10 * 1e3
     a, b = t(with_sync), t(without_sync)
     out[str(ndev)] = {"step_ms": round(a, 3), "nosync_ms": round(b, 3),
-                      "collective_ms": round(a - b, 3)}
+                      "collective_ms": round(max(a - b, 0.0), 3)}
 out["note"] = ("virtual CPU devices on one physical core: measures the "
                "framework's psum dispatch/copy overhead per mesh shape, "
-               "not ICI bandwidth (no multi-chip hardware available)")
+               "not ICI bandwidth (no multi-chip hardware available); "
+               "best-of-5 windows of 10 calls per point")
 print(json.dumps(out))
 """
     env = dict(os.environ)
@@ -502,7 +823,7 @@ print(json.dumps(out))
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         " --xla_force_host_platform_device_count=8").strip()
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, timeout=240, env=env,
+                         text=True, timeout=420, env=env,
                          cwd=os.path.dirname(os.path.abspath(__file__)))
     lines = out.stdout.strip().splitlines()
     if out.returncode != 0 or not lines:
@@ -525,13 +846,13 @@ def _global_warmup(seconds: float = 5.0):
     jax.block_until_ready(a)
 
 
-def _mfu(rate_per_sec, per_what, flops_per_step, batch_like):
+def _mfu_entry(dt, per_what, flops_per_step):
     """Achieved TFLOP/s + MFU from XLA's per-step flop estimate and the
-    measured rate. rate is items/sec; batch_like items per step."""
-    if not flops_per_step:
+    measured (validated) per-step time. Only called for rows that passed
+    the roofline guard, so mfu here is always <= MAX_PLAUSIBLE_MFU."""
+    if not flops_per_step or not dt:
         return None
-    steps_per_sec = rate_per_sec / batch_like
-    achieved = flops_per_step * steps_per_sec / 1e12
+    achieved = flops_per_step / dt / 1e12
     return {"achieved_tflops": round(achieved, 2),
             "mfu": round(achieved / PEAK_TFLOPS, 4),
             "flops_per_step": flops_per_step, "per": per_what}
@@ -548,58 +869,65 @@ def main():
     _stage("warmup", t0)
     mfu = {}
     t0 = time.perf_counter()
-    ours, fl = bench_ours()
+    ours_row, ours_dt, fl = bench_ours(label="resnet50_f32")
     _stage("resnet50_f32_ours", t0)
-    mfu["resnet50_f32"] = _mfu(ours, "step(batch=%d)" % BATCH, fl, BATCH)
+    mfu["resnet50_f32"] = _mfu_entry(ours_dt, "step(batch=%d)" % BATCH, fl)
+    ours = _rowval(ours_row)
     t0 = time.perf_counter()
     try:
-        ref, _ = bench_reference()
+        ref_row, _, _ = bench_reference()
+        ref = _rowval(ref_row)
     except Exception as e:
         print(f"reference bench failed: {e}", file=sys.stderr)
         ref = None
     _stage("resnet50_f32_flax", t0)
-    ratio = (ours / ref) if ref else None
+    ratio = (ours / ref) if (ours and ref) else None
 
     bf16_batch = BATCH if "BENCH_BATCH" in os.environ else 128
 
     def _bf16_ours():
         # bf16 halves activation memory, so a larger batch fits and feeds
         # the MXU better. An explicit BENCH_BATCH is honored (memory bound).
-        r, f = bench_ours(dtype="bfloat16", batch=bf16_batch)
-        mfu["resnet50_bf16"] = _mfu(r, f"step(batch={bf16_batch})", f,
-                                    bf16_batch)
-        return r
+        row, dt, f = bench_ours(dtype="bfloat16", batch=bf16_batch,
+                                label="resnet50_bf16")
+        mfu["resnet50_bf16"] = _mfu_entry(dt, f"step(batch={bf16_batch})", f)
+        return row
 
     def _bf16_flax():
-        r, _ = bench_reference(dtype="bfloat16", batch=bf16_batch)
-        return r
+        row, _, _ = bench_reference(dtype="bfloat16", batch=bf16_batch)
+        return row
 
     def _amp_ours():
         # the PRACTICAL recipe: f32 master params/updater, bf16 compute
-        r, f = bench_ours(dtype="float32", compute_dtype="bfloat16",
-                          batch=bf16_batch)
-        mfu["resnet50_amp"] = _mfu(r, f"step(batch={bf16_batch})", f,
-                                    bf16_batch)
-        return r
+        row, dt, f = bench_ours(dtype="float32", compute_dtype="bfloat16",
+                                batch=bf16_batch, label="resnet50_amp")
+        mfu["resnet50_amp"] = _mfu_entry(dt, f"step(batch={bf16_batch})", f)
+        return row
+
+    def _piped():
+        row, dt, f = bench_piped(batch=bf16_batch)
+        mfu["resnet50_piped"] = _mfu_entry(dt, f"step(batch={bf16_batch})", f)
+        return row
 
     def _lstm(cell="graves"):
-        r, f = bench_lstm(cell)
+        row, dt, f = bench_lstm(cell)
         if cell == "plain":
-            mfu["lstm_plain"] = _mfu(r, "step(B=32,T=64)", f, 32 * 64)
-        return r
+            mfu["lstm_plain"] = _mfu_entry(dt, "step(B=32,T=64)", f)
+        return row
 
     extras = {}
     # hard wall-clock budget: the driver must ALWAYS get the JSON line, so
     # extras are skipped (reported null) once the budget is spent
     # slope-timed LSTM stages compile two loop programs each; 480s starved
     # the tail extras (r3), hence the raised default
-    budget = float(os.environ.get("BENCH_BUDGET_S", "1200"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     t_start = time.perf_counter()
     if os.environ.get("BENCH_SKIP_EXTRAS", "0") != "1":
         for name, fn in [
             ("resnet50_bf16_img_per_sec", _bf16_ours),
             ("resnet50_bf16_flax_img_per_sec", _bf16_flax),
             ("resnet50_amp_img_per_sec", _amp_ours),
+            ("resnet50_piped_img_per_sec", _piped),
             ("lstm_train_tokens_per_sec", _lstm),
             ("lstm_plain_tokens_per_sec", lambda: _lstm("plain")),
             ("lstm_reference_tokens_per_sec", bench_lstm_reference),
@@ -620,23 +948,28 @@ def main():
                 print(f"extra bench {name} failed: {e}", file=sys.stderr)
                 extras[name] = None
             _stage(name, t0)
-        if extras.get("lstm_plain_tokens_per_sec") and \
-                extras.get("lstm_reference_tokens_per_sec"):
+        lp = _rowval(extras.get("lstm_plain_tokens_per_sec"))
+        lr = _rowval(extras.get("lstm_reference_tokens_per_sec"))
+        if lp and lr:
             # plain-vs-plain: both sides are standard (no-peephole) LSTMs
-            extras["lstm_vs_reference"] = round(
-                extras["lstm_plain_tokens_per_sec"]
-                / extras["lstm_reference_tokens_per_sec"], 3)
-        if extras.get("resnet50_bf16_img_per_sec") and \
-                extras.get("resnet50_bf16_flax_img_per_sec"):
-            extras["resnet50_bf16_vs_flax_bf16"] = round(
-                extras["resnet50_bf16_img_per_sec"]
-                / extras["resnet50_bf16_flax_img_per_sec"], 3)
+            extras["lstm_vs_reference"] = round(lp / lr, 3)
+        ob = _rowval(extras.get("resnet50_bf16_img_per_sec"))
+        fb = _rowval(extras.get("resnet50_bf16_flax_img_per_sec"))
+        if ob and fb:
+            extras["resnet50_bf16_vs_flax_bf16"] = round(ob / fb, 3)
+        pa = _rowval(extras.get("resnet50_piped_img_per_sec"))
+        aa = _rowval(extras.get("resnet50_amp_img_per_sec"))
+        if pa and aa:
+            # the measured pipeline tax: piped / device-resident
+            extras["resnet50_piped_vs_resident"] = round(pa / aa, 3)
     # the headline f32 MFU is computed regardless of BENCH_SKIP_EXTRAS
     extras["mfu"] = {k: v for k, v in mfu.items() if v} or None
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
-        "value": round(ours, 2),
+        "value": round(ours, 2) if ours else None,
+        "invalid_reason": (ours_row.get("invalid_reason")
+                           if isinstance(ours_row, dict) else None),
         "unit": "img/sec",
         "vs_baseline": round(ratio, 3) if ratio else None,
         "config": {"batch": BATCH, "img": IMG, "dtype": "float32"},

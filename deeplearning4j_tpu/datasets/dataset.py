@@ -21,6 +21,9 @@ class DataSet:
     labels: np.ndarray
     features_mask: Optional[np.ndarray] = None
     labels_mask: Optional[np.ndarray] = None
+    # optional per-example record metadata (reference RecordMetaData, carried
+    # through evaluate() into Evaluation's prediction records); length = N
+    metadata: Optional[List] = None
 
     def num_examples(self) -> int:
         f = self.features[0] if isinstance(self.features, (list, tuple)) else self.features

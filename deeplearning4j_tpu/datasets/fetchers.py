@@ -78,7 +78,7 @@ def load_cifar10(cache_dir: str = DEFAULT_CACHE, train: bool = True,
     tgz = os.path.join(cache_dir, "cifar-10-python.tar.gz")
     if not os.path.isdir(root) and os.path.exists(tgz):
         with tarfile.open(tgz, "r:gz") as tf:
-            tf.extractall(cache_dir)  # noqa: S202 (local cache archive)
+            tf.extractall(cache_dir, filter="data")  # refuse path traversal
     if os.path.isdir(root):
         names = ([f"data_batch_{i}" for i in range(1, 6)] if train
                  else ["test_batch"])
@@ -178,7 +178,7 @@ def load_lfw(cache_dir: str = DEFAULT_CACHE, *, height: int = 64,
     tgz = os.path.join(cache_dir, "lfw.tgz")
     if not os.path.isdir(root) and os.path.exists(tgz):
         with tarfile.open(tgz, "r:gz") as tf:
-            tf.extractall(cache_dir)  # noqa: S202 (local cache archive)
+            tf.extractall(cache_dir, filter="data")  # refuse path traversal
     if os.path.isdir(root):
         from PIL import Image
         people = []

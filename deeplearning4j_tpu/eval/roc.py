@@ -6,6 +6,7 @@ the reference's thresholded mode was a memory optimization irrelevant here.
 """
 from __future__ import annotations
 
+import json
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -97,6 +98,28 @@ class ROC:
         d_recall = np.diff(np.concatenate([[0.0], recall]))
         return float(np.sum(precision * d_recall))
 
+    # ----------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        """Exact-mode state is the raw (label, score) samples — the JSON
+        carries them whole (reference BaseEvaluation.toJson; its exact-mode
+        ROC serializes the underlying arrays the same way)."""
+        y, s = (self._all() if self.labels
+                else (np.empty(0), np.empty(0)))
+        return json.dumps({"type": type(self).__name__,
+                           "labels": y.tolist(), "scores": s.tolist()})
+
+    @classmethod
+    def from_json(cls, payload: str) -> "ROC":
+        d = json.loads(payload)
+        if d.get("type") != cls.__name__:
+            raise ValueError(f"not a {cls.__name__} JSON payload: "
+                             f"{d.get('type')!r}")
+        r = cls()
+        if d["labels"]:
+            r.labels.append(np.asarray(d["labels"], float))
+            r.scores.append(np.asarray(d["scores"], float))
+        return r
+
 
 class ROCBinary:
     """Independent binary ROC per output column (reference ROCBinary.java)."""
@@ -133,6 +156,13 @@ class ROCBinary:
             mine.merge(theirs)
         return self
 
+    def to_json(self) -> str:
+        return _multi_to_json(self)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "ROCBinary":
+        return _multi_from_json(cls, payload)
+
 
 class ROCMultiClass:
     """One-vs-all ROC per class (reference ROCMultiClass.java)."""
@@ -167,3 +197,29 @@ class ROCMultiClass:
         for mine, theirs in zip(self._rocs, other._rocs):
             mine.merge(theirs)
         return self
+
+    def to_json(self) -> str:
+        return _multi_to_json(self)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "ROCMultiClass":
+        return _multi_from_json(cls, payload)
+
+
+def _multi_to_json(obj) -> str:
+    rocs = obj._rocs
+    return json.dumps({
+        "type": type(obj).__name__,
+        "rocs": ([json.loads(r.to_json()) for r in rocs]
+                 if rocs is not None else None)})
+
+
+def _multi_from_json(cls, payload: str):
+    d = json.loads(payload)
+    if d.get("type") != cls.__name__:
+        raise ValueError(f"not a {cls.__name__} JSON payload: "
+                         f"{d.get('type')!r}")
+    obj = cls()
+    if d["rocs"] is not None:
+        obj._rocs = [ROC.from_json(json.dumps(rd)) for rd in d["rocs"]]
+    return obj

@@ -126,7 +126,8 @@ class KerasLayerTranslator:
             border = cfg.get("border_mode") or cfg.get("padding") or "valid"
             return SubsamplingLayer(pooling_type=pt, kernel_size=k, stride=s,
                                     convolution_mode="same" if border == "same"
-                                    else "truncate")
+                                    else "truncate",
+                                    avg_pool_include_pad_in_divisor=False)
         if klass in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
                      "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
             return GlobalPoolingLayer(pooling_type="avg" if "Average" in klass
@@ -159,7 +160,8 @@ class KerasLayerTranslator:
             return Subsampling1DLayer(
                 pooling_type="max" if klass.startswith("Max") else "avg",
                 kernel_size=int(k), stride=int(s),
-                convolution_mode="same" if border == "same" else "truncate")
+                convolution_mode="same" if border == "same" else "truncate",
+                avg_pool_include_pad_in_divisor=False)
         if klass == "ZeroPadding1D":
             pad = cfg.get("padding", 1)
             if isinstance(pad, (list, tuple)):

@@ -16,17 +16,27 @@ one lattice engine:
 - Viterbi dynamic programming picks the min-cost path, word cost
   -log(freq/total) and a length-scaled unknown penalty.
 
-A compact embedded core vocabulary (common function words + everyday nouns/
-verbs) makes the segmenters usable out of the box; real deployments load a
-full dictionary via ``load_tsv`` / ``add_word`` — the same extension seam as
-the reference's user-dictionary files. ``CJKTokenizerFactory(language=...)``
-in nlp/tokenizer.py uses these as its default segmenter.
+The PRODUCTION dictionary path: real-scale lexicons ship as package data
+(``nlp/data/zh_dict.tsv`` — 52k entries derived from the MIT-licensed jieba
+dict; ``nlp/data/ja_dict.tsv`` — compiled from an ipadic-tokenized
+public-domain corpus; built by ``tools/build_cjk_dicts.py``) and are loaded
+by default by ``ChineseSegmenter``/``JapaneseSegmenter``. The compact
+embedded cores below are only the fallback when the data files are absent.
+User dictionaries extend via ``load_tsv`` / ``add_word`` — the same seam as
+the reference packs' user-dictionary files. ``CJKTokenizerFactory(
+language=...)`` in nlp/tokenizer.py uses these as its default segmenter.
 """
 from __future__ import annotations
 
 import math
+import os
 import unicodedata
 from typing import Dict, Iterable, List, Optional, Tuple
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+# memoized parses of dictionary files (keyed by path; bundled lexicons are
+# immutable package data)
+_TSV_CACHE: Dict[str, Dict[str, Tuple[int, str]]] = {}
 
 
 def _char_class(ch: str) -> str:
@@ -60,6 +70,7 @@ class LatticeSegmenter:
     def __init__(self, dictionary: Optional[Dict[str, int]] = None, *,
                  unk_cost: float = 14.0, unk_char_cost: float = 3.0):
         self._freq: Dict[str, int] = {}
+        self._pos: Dict[str, str] = {}
         self._prefixes = set()
         self._total = 0
         self._max_len = 1
@@ -69,28 +80,43 @@ class LatticeSegmenter:
             self.add_word(w, f)
 
     # ------------------------------------------------------------ dictionary
-    def add_word(self, word: str, freq: int = 1):
+    def add_word(self, word: str, freq: int = 1, pos: str = ""):
         word = unicodedata.normalize("NFKC", word)
         if not word:
             return self
         self._total += max(freq, 1) - self._freq.get(word, 0)
         self._freq[word] = max(freq, 1)
+        if pos:
+            self._pos[word] = pos
         self._max_len = max(self._max_len, len(word))
         for i in range(1, len(word) + 1):
             self._prefixes.add(word[:i])
         return self
 
     def load_tsv(self, path: str):
-        """Load 'word<TAB>freq' (or 'word freq' / bare 'word') lines — the
-        user-dictionary seam of the reference language packs."""
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                parts = line.strip().split()
-                if not parts or parts[0].startswith("#"):
-                    continue
-                self.add_word(parts[0],
-                              int(parts[1]) if len(parts) > 1 else 1)
+        """Load the dictionary-file format 'word<TAB>freq<TAB>pos' (freq and
+        pos optional, '#' comments) — the PRODUCTION dictionary path and the
+        user-dictionary seam of the reference language packs (see
+        nlp/dict_build.py for the compile step that produces these files).
+        Parses are memoized per path: the bundled 52k-entry lexicon is
+        immutable package data and every tokenizer-factory construction
+        would otherwise re-parse it."""
+        entries = _TSV_CACHE.get(path)
+        if entries is None:
+            from .dict_build import read_dict_tsv
+            entries = _TSV_CACHE[path] = read_dict_tsv(path)
+        for w, (freq, pos) in entries.items():
+            self.add_word(w, freq, pos)
         return self
+
+    def pos_of(self, word: str) -> str:
+        """Dictionary POS tag for a word ('' when unknown) — the lexicon
+        carries POS like the reference packs' dictionaries (ansj natures,
+        ipadic features)."""
+        return self._pos.get(unicodedata.normalize("NFKC", word), "")
+
+    def __len__(self):
+        return len(self._freq)
 
     def __contains__(self, w):
         return w in self._freq
@@ -217,21 +243,46 @@ _JA_CORE = {
 }
 
 
-class ChineseSegmenter(LatticeSegmenter):
+class _BundledSegmenter(LatticeSegmenter):
+    """Shared init: load the bundled real-scale lexicon when present (the
+    PRODUCTION path), back-merge the embedded bootstrap core for entries
+    the bundled file lacks (frequency cutoffs / corpus gaps drop some
+    function words and domain compounds), then apply user extras on top."""
+
+    _BUNDLED_FILE = ""           # subclasses set these
+    _CORE: Dict[str, int] = {}
+
+    def __init__(self, extra_words: Optional[Dict[str, int]] = None, *,
+                 use_bundled: bool = True, **kw):
+        super().__init__(**kw)
+        bundled = os.path.join(_DATA_DIR, self._BUNDLED_FILE)
+        if use_bundled and os.path.exists(bundled):
+            self.load_tsv(bundled)
+            for w, f in self._CORE.items():
+                if w not in self:
+                    self.add_word(w, f)
+        else:
+            for w, f in self._CORE.items():
+                self.add_word(w, f)
+        for w, f in (extra_words or {}).items():
+            self.add_word(w, f)
+
+
+class ChineseSegmenter(_BundledSegmenter):
     """Dictionary/DAG segmenter for simplified Chinese (the ansj capability,
-    deeplearning4j-nlp-chinese org/ansj/)."""
+    deeplearning4j-nlp-chinese org/ansj/). Loads the bundled real-scale
+    lexicon (nlp/data/zh_dict.tsv, ~52k entries with POS) by default;
+    ``use_bundled=False`` keeps only the embedded bootstrap core."""
 
-    def __init__(self, extra_words: Optional[Dict[str, int]] = None, **kw):
-        super().__init__(dict(_ZH_CORE), **kw)
-        for w, f in (extra_words or {}).items():
-            self.add_word(w, f)
+    _BUNDLED_FILE = "zh_dict.tsv"
+    _CORE = _ZH_CORE
 
 
-class JapaneseSegmenter(LatticeSegmenter):
+class JapaneseSegmenter(_BundledSegmenter):
     """Lattice + Viterbi segmenter for Japanese (the kuromoji capability,
-    deeplearning4j-nlp-japanese com/atilika/kuromoji/)."""
+    deeplearning4j-nlp-japanese com/atilika/kuromoji/). Loads the bundled
+    corpus-compiled lexicon (nlp/data/ja_dict.tsv) by default;
+    ``use_bundled=False`` keeps only the embedded bootstrap core."""
 
-    def __init__(self, extra_words: Optional[Dict[str, int]] = None, **kw):
-        super().__init__(dict(_JA_CORE), **kw)
-        for w, f in (extra_words or {}).items():
-            self.add_word(w, f)
+    _BUNDLED_FILE = "ja_dict.tsv"
+    _CORE = _JA_CORE

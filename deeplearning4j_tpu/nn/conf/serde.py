@@ -18,7 +18,14 @@ from typing import Any, Dict, Type
 
 _REGISTRY: Dict[str, Type] = {}
 
-CONFIG_FORMAT_VERSION = 1
+# v2: SubsamplingLayer/Subsampling1DLayer gained
+# avg_pool_include_pad_in_divisor and serialize it explicitly. Payloads
+# without the field (v1) deserialize to the reference semantics (True) —
+# the long-standing contract; the brief window where SAME avg-pool used
+# TF-style exclude-pad unconditionally was a deviation (see ADVICE r3) and
+# is not preserved. The Keras importer has always been the only exclude-pad
+# producer and now records the field explicitly.
+CONFIG_FORMAT_VERSION = 2
 
 
 def register(cls):

@@ -369,7 +369,7 @@ class ComputationGraph:
         n_out = len(self.conf.network_outputs)
         evals = [Evaluation() for _ in range(n_out)]
 
-        def eval_batch(features, labels, lmask):
+        def eval_batch(features, labels, lmask, metadata=None):
             outs = self.output(*_as_list(features))
             outs = outs if isinstance(outs, list) else [outs]
             labels_l = _as_list(labels)
@@ -386,13 +386,17 @@ class ComputationGraph:
                     f"unmasked outputs)")
             for e, o, l, m in zip(evals, outs, labels_l, masks_l):
                 if l is not None:
-                    e.eval(l, np.asarray(o), mask=m)
+                    # per-example metadata only applies to 2D outputs; a
+                    # time-series output evaluates without records
+                    md = metadata if np.asarray(l).ndim != 3 else None
+                    e.eval(l, np.asarray(o), mask=m, record_meta_data=md)
 
         if y is not None:
             eval_batch(iterator_or_x, y, None)
         else:
             for ds in iterator_or_x:
-                eval_batch(ds.features, ds.labels, ds.labels_mask)
+                eval_batch(ds.features, ds.labels, ds.labels_mask,
+                           metadata=getattr(ds, "metadata", None))
         return evals[0] if n_out == 1 else evals
 
     def clone(self) -> "ComputationGraph":

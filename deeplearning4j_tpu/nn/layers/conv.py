@@ -155,6 +155,12 @@ class SubsamplingLayer(LayerConf):
     padding: Tuple[int, int] = (0, 0)
     convolution_mode: str = "truncate"
     pnorm: int = 2
+    # Reference SubsamplingLayer averages over the full (zero-padded) window
+    # (activate: col2d.mean over the padded im2col; backprop divides by
+    # prod(kernelSize)); TF/Keras excludes implicit padding. Default matches
+    # the reference; the Keras importer sets False (DL4J's own
+    # avgPoolIncludePadInDivisor seam).
+    avg_pool_include_pad_in_divisor: bool = True
 
     expected_input: ClassVar[str] = "cnn"
 
@@ -179,7 +185,7 @@ class SubsamplingLayer(LayerConf):
         elif pt in ("avg", "sum"):
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if pt == "avg":
-                if pad == "SAME":
+                if pad == "SAME" and not self.avg_pool_include_pad_in_divisor:
                     # exclude implicit padding from the denominator (TF/Keras
                     # semantics; windows at the edge average over fewer cells)
                     ones = jnp.ones(x.shape[:1] + x.shape[1:3] + (1,), x.dtype)
@@ -205,6 +211,8 @@ class Subsampling1DLayer(LayerConf):
     padding: int = 0
     convolution_mode: str = "truncate"
     pnorm: int = 2
+    # see SubsamplingLayer: reference divides by the full kernel size
+    avg_pool_include_pad_in_divisor: bool = True
 
     expected_input: ClassVar[str] = "rnn"
 
@@ -225,7 +233,7 @@ class Subsampling1DLayer(LayerConf):
         elif pt in ("avg", "sum"):
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if pt == "avg":
-                if pad == "SAME":
+                if pad == "SAME" and not self.avg_pool_include_pad_in_divisor:
                     # exclude implicit padding (TF/Keras edge semantics)
                     ones = jnp.ones(x.shape[:2] + (1,), x.dtype)
                     cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides,

@@ -340,6 +340,9 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------ eval
     def evaluate(self, iterator_or_x, y=None):
+        """Iterator batches carrying per-example ``metadata`` feed the
+        prediction-record workflow (Evaluation.get_prediction_errors etc.;
+        reference MultiLayerNetwork.doEvaluation + eval/meta)."""
         from ..eval.evaluation import Evaluation
         e = Evaluation()
         if y is not None:
@@ -347,7 +350,11 @@ class MultiLayerNetwork:
             return e
         for ds in iterator_or_x:
             out = np.asarray(self.output(ds.features))
-            e.eval(ds.labels, out, mask=ds.labels_mask)
+            # metadata is per-example; time-series labels flatten to N*T
+            # rows, so the record workflow doesn't apply there
+            md = (getattr(ds, "metadata", None)
+                  if np.asarray(ds.labels).ndim != 3 else None)
+            e.eval(ds.labels, out, mask=ds.labels_mask, record_meta_data=md)
         return e
 
     # ------------------------------------------------------------------ misc

@@ -75,9 +75,14 @@ def fused_lstm_applicable(B: int, H: int, dtype, *, peepholes, mask,
         return False
     if H % 128 != 0 or B % min_b != 0 or H > _MAX_FUSED_H:
         return False
-    if jax.default_backend() not in ("tpu", "cpu"):
-        return False
-    return True
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend == "cpu":
+        # interpret mode is orders of magnitude slower than the scan
+        # fallback — only the parity tests want it (opt-in via env var)
+        return os.environ.get("DL4J_TPU_FUSED_LSTM_INTERPRET", "0") == "1"
+    return False
 
 
 def _interpret() -> bool:

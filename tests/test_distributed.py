@@ -67,6 +67,76 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+_WORKER4 = textwrap.dedent("""
+import os, sys
+import numpy as np
+pid = int(sys.argv[1])
+port = sys.argv[2]
+ckpt_dir = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.parallel import distributed
+distributed.initialize_distributed(f"127.0.0.1:{port}", num_processes=4,
+                                   process_id=pid, cpu_collectives="gloo")
+assert distributed.process_count() == 4
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from deeplearning4j_tpu.util.distributed_checkpoint import (
+    save_sharded_checkpoint, restore_sharded_checkpoint)
+
+# 2x2 data x model mesh over 4 single-device processes
+mesh = distributed.global_mesh(("data", "model"), shape=(2, 2))
+assert mesh.devices.shape == (2, 2)
+
+# a tensor-parallel matmul + data-parallel batch: y = x @ W with W sharded
+# over 'model' columns and x sharded over 'data' rows
+W_global = np.arange(16, dtype=np.float32).reshape(4, 4)
+x_global = np.arange(32, dtype=np.float32).reshape(8, 4) / 10.0
+wsh = NamedSharding(mesh, P(None, "model"))
+xsh = NamedSharding(mesh, P("data", None))
+# each process owns one device = one (data, model) block
+W = jax.make_array_from_callback((4, 4), wsh, lambda idx: W_global[idx])
+x = jax.make_array_from_callback((8, 4), xsh, lambda idx: x_global[idx])
+
+@jax.jit
+def f(x, W):
+    return x @ W
+y = f(x, W)
+y_local = np.asarray(y.addressable_shards[0].data)
+want = (x_global @ W_global)
+idx = y.addressable_shards[0].index
+np.testing.assert_allclose(y_local, want[idx], rtol=1e-6)
+
+# ---- distributed checkpoint across 4 processes: every process writes its
+# own shard file; process 0 writes the manifest; all restore and verify
+tree = {"W": W, "x": x}
+save_sharded_checkpoint(ckpt_dir, 11, tree)
+# wait until all 4 per-process files + manifest exist (shared tmp dir)
+import time
+deadline = time.time() + 60
+while time.time() < deadline:
+    names = set(os.listdir(ckpt_dir))
+    if {"ckpt_step11.json"} | {f"ckpt_step11_p{i:03d}.npz" for i in range(4)} \
+            <= names:
+        break
+    time.sleep(0.2)
+like = {"W": jax.make_array_from_callback((4, 4), wsh,
+                                          lambda idx: np.zeros((4, 4),
+                                          np.float32)[idx]),
+        "x": jax.make_array_from_callback((8, 4), xsh,
+                                          lambda idx: np.zeros((8, 4),
+                                          np.float32)[idx])}
+got = restore_sharded_checkpoint(ckpt_dir, 11, like)
+np.testing.assert_array_equal(
+    np.asarray(got["W"].addressable_shards[0].data),
+    np.asarray(W.addressable_shards[0].data))
+np.testing.assert_array_equal(
+    np.asarray(got["x"].addressable_shards[0].data),
+    np.asarray(x.addressable_shards[0].data))
+print(f"WORKER_{pid}_OK", flush=True)
+""")
+
+
 def test_two_process_cpu_distributed(tmp_path):
     port = _free_port()
     env = dict(os.environ)
@@ -94,3 +164,34 @@ def test_two_process_cpu_distributed(tmp_path):
     g1 = [l for l in outs[1].splitlines() if l.startswith("PID 1 grad00")]
     assert g0 and g1
     assert g0[0].split()[-1] == g1[0].split()[-1]
+
+
+@pytest.mark.slow
+def test_four_process_mesh_and_distributed_checkpoint(tmp_path):
+    """4 CPU processes on a 2x2 data x model mesh: tensor-parallel matmul
+    correctness + cross-process sharded checkpoint save/restore (VERDICT r3
+    item 3; reference analogue: the Spark driver's resumable mid-run state,
+    ParameterAveragingTrainingWorker.java:269)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)   # exactly 1 local CPU device per process
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER4, str(i), str(port), ckpt],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(4)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert f"WORKER_{i}_OK" in out

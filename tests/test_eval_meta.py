@@ -1,0 +1,185 @@
+"""Per-example prediction metadata + eval JSON serde.
+
+Reference workflow: eval/meta/Prediction.java + Evaluation.java:297-361
+(metadata-aware eval), :1490 (getPredictionErrors), :1567
+(getPredictionByPredictedClass); BaseEvaluation JSON round-trip.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.eval import (Evaluation, Prediction, ROC, ROCBinary,
+                                     ROCMultiClass)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+R = np.random.default_rng(7)
+
+
+def _probs(rows):
+    p = np.asarray(rows, float)
+    return p / p.sum(axis=1, keepdims=True)
+
+
+def test_meta_confusion_and_getters():
+    e = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 2, 2]]
+    preds = _probs([[.8, .1, .1],    # 0 -> 0 correct
+                    [.1, .7, .2],    # 0 -> 1 WRONG (p=.7)
+                    [.2, .6, .2],    # 1 -> 1 correct
+                    [.9, .05, .05],  # 2 -> 0 WRONG (p=.9)
+                    [.1, .2, .7]])   # 2 -> 2 correct
+    meta = [f"rec{i}" for i in range(5)]
+    e.eval(labels, preds, record_meta_data=meta)
+
+    errors = e.get_prediction_errors()
+    assert [(p.actual_class, p.predicted_class, p.record_meta_data)
+            for p in errors] == [(0, 1, "rec1"), (2, 0, "rec3")]
+
+    by_actual = e.get_predictions_by_actual_class(2)
+    assert sorted(p.record_meta_data for p in by_actual) == ["rec3", "rec4"]
+    by_pred = e.get_prediction_by_predicted_class(0)
+    assert sorted(p.record_meta_data for p in by_pred) == ["rec0", "rec3"]
+    cell = e.get_predictions(2, 0)
+    assert [p.record_meta_data for p in cell] == ["rec3"]
+
+    # worst-k: most-confidently-wrong first
+    worst = e.get_worst_predictions(1)
+    assert worst[0].record_meta_data == "rec3"
+    assert worst[0].probability == pytest.approx(0.9)
+
+    # without metadata the getters return None (reference contract)
+    e2 = Evaluation()
+    e2.eval(labels, preds)
+    assert e2.get_prediction_errors() is None
+    assert e2.get_predictions_by_actual_class(0) is None
+
+
+def test_meta_with_mask_and_merge():
+    e = Evaluation()
+    labels = np.eye(2)[[0, 1, 1]]
+    preds = _probs([[.9, .1], [.8, .2], [.3, .7]])
+    mask = np.asarray([1, 1, 0])
+    e.eval(labels, preds, mask=mask, record_meta_data=["a", "b", "c"])
+    # masked-out example "c" is dropped everywhere
+    assert e.count == 2
+    assert [p.record_meta_data for p in e.get_prediction_errors()] == ["b"]
+
+    other = Evaluation()
+    other.eval(np.eye(2)[[0]], _probs([[.2, .8]]), record_meta_data=["d"])
+    e.merge(other)
+    assert sorted(p.record_meta_data for p in e.get_prediction_errors()) == \
+        ["b", "d"]
+
+
+def test_meta_timeseries_rejected():
+    e = Evaluation()
+    with pytest.raises(ValueError, match="per-example"):
+        e.eval(np.zeros((2, 3, 4)), np.zeros((2, 3, 4)),
+               record_meta_data=["a", "b"])
+
+
+def test_fit_evaluate_worst_k_workflow():
+    """The end-to-end debugging workflow: fit, evaluate(iterator) with
+    metadata-carrying DataSets, pull the worst-k predictions."""
+    n, d, c = 120, 6, 3
+    x = R.normal(size=(n, d)).astype(np.float32)
+    w = R.normal(size=(d, c))
+    y_idx = np.argmax(x @ w + 0.3 * R.normal(size=(n, c)), axis=1)
+    y = np.eye(c, dtype=np.float32)[y_idx]
+
+    conf = (NeuralNetConfiguration(seed=1, updater=Adam(1e-2), dtype="float32")
+            .list(DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=c, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=30, batch_size=32)
+
+    batches = [DataSet(x[i:i + 40], y[i:i + 40],
+                       metadata=[{"row": j} for j in range(i, i + 40)])
+               for i in range(0, n, 40)]
+    e = net.evaluate(iter(batches))
+    assert e.accuracy() > 0.5
+    errors = e.get_prediction_errors()
+    assert errors is not None
+    n_err = int(e.confusion.sum() - np.trace(e.confusion))
+    assert len(errors) == n_err
+    worst = e.get_worst_predictions(5)
+    assert len(worst) == min(5, n_err)
+    # ranked descending by wrong-class confidence, metadata identifies rows
+    probs = [p.probability for p in worst]
+    assert probs == sorted(probs, reverse=True)
+    for p in worst:
+        assert 0 <= p.record_meta_data["row"] < n
+        assert p.actual_class != p.predicted_class
+        # the metadata points back at the actual example
+        assert y_idx[p.record_meta_data["row"]] == p.actual_class
+
+
+def test_evaluation_json_round_trip():
+    e = Evaluation(top_n=2)
+    labels = np.eye(3)[[0, 1, 2, 1]]
+    preds = _probs([[.6, .3, .1], [.2, .5, .3], [.1, .2, .7], [.6, .3, .1]])
+    e.eval(labels, preds, record_meta_data=[{"id": i} for i in range(4)])
+    e2 = Evaluation.from_json(e.to_json())
+    assert np.array_equal(e2.confusion, e.confusion)
+    assert e2.count == e.count and e2.top_n == 2
+    assert e2.accuracy() == e.accuracy()
+    assert [(p.actual_class, p.predicted_class, p.record_meta_data)
+            for p in e2.get_prediction_errors()] == \
+        [(p.actual_class, p.predicted_class, p.record_meta_data)
+         for p in e.get_prediction_errors()]
+    # round-tripped object keeps accumulating
+    e2.eval(labels, preds)
+    assert e2.count == 8
+
+
+def test_roc_json_round_trip():
+    y = (R.random(200) > 0.5).astype(float)
+    s = np.clip(y * 0.6 + R.random(200) * 0.5, 0, 1)
+    r = ROC()
+    r.eval(y, s)
+    r2 = ROC.from_json(r.to_json())
+    assert r2.calculate_auc() == pytest.approx(r.calculate_auc())
+    assert r2.calculate_auprc() == pytest.approx(r.calculate_auprc())
+
+    labels = np.stack([y, 1 - y], axis=1)
+    scores = np.stack([s, 1 - s], axis=1)
+    for cls in (ROCBinary, ROCMultiClass):
+        m = cls()
+        m.eval(labels, scores)
+        m2 = cls.from_json(m.to_json())
+        assert m2.calculate_average_auc() == \
+            pytest.approx(m.calculate_average_auc())
+    # type tag is checked
+    with pytest.raises(ValueError, match="payload"):
+        ROCBinary.from_json(r.to_json())
+
+
+def test_prediction_repr():
+    p = Prediction(1, 2, "rec9", 0.93)
+    assert "actual=1" in repr(p) and "rec9" in repr(p)
+
+
+def test_evaluate_with_metadata_on_timeseries_does_not_crash():
+    """A metadata-carrying DataSet with [N,T,C] labels must still evaluate
+    (records skipped — they're per-example), not raise."""
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration(seed=1, updater=Adam(1e-2),
+                                   dtype="float32")
+            .list(LSTM(n_out=8, activation="tanh"),
+                  RnnOutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(5, 4, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[R.integers(0, 3, (5, 4))]
+    ds = DataSet(x, y, metadata=[f"seq{i}" for i in range(5)])
+    e = net.evaluate(iter([ds]))
+    assert e.count == 20                       # 5 sequences x 4 steps
+    assert e.get_prediction_errors() is None   # no per-example records

@@ -1,0 +1,151 @@
+"""Central-difference gradient checks for the net-new parallel blocks
+(VERDICT r3 item 7): MoE top-2 (router + experts), a pipeline-wrapped block
+stack, and GravesBidirectionalLSTM-with-mask.
+
+These paths had parity/convergence tests but no numerical gradient
+verification — the repo's stated backbone (SURVEY.md §4; reference
+GradientCheckUtil forces DOUBLE, GradientCheckUtil.java:92-97).
+
+The fused Pallas path is f32-only by design (fused_lstm_applicable rejects
+f64), so the bidirectional-with-mask check verifies the f64 SCAN twin
+numerically here; tests/test_pallas_lstm.py::
+test_bidirectional_layer_fused_matches_scan ties the fused VJP to that
+scan math at f32 — together the fused path is numerically anchored.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM, RnnOutputLayer
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel.expert_parallel import expert_parallel_apply
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.pipeline import (pipeline_apply,
+                                                  stack_stage_params)
+from deeplearning4j_tpu.util.gradcheck import check_gradients
+
+R = np.random.default_rng(99)
+
+
+def _central_diff_check(loss, flat0, *, subset=40, epsilon=1e-6,
+                        max_rel_error=1e-3, min_abs_error=1e-8, seed=0):
+    """f64 central differences vs jax.grad for an arbitrary flat-vector
+    loss (the _check_flat contract, re-implemented with a plain loop so the
+    loss may contain jitted shard_map programs that vmap can't batch)."""
+    flat0 = np.asarray(flat0, np.float64)
+    analytic = np.asarray(jax.grad(loss)(jnp.asarray(flat0)))
+    n = flat0.shape[0]
+    idxs = (np.random.default_rng(seed).choice(n, subset, replace=False)
+            if subset < n else np.arange(n))
+    fails, max_rel = 0, 0.0
+    for i in idxs:
+        row = flat0.copy()
+        row[i] += epsilon
+        lp = float(loss(jnp.asarray(row)))
+        row[i] = flat0[i] - epsilon
+        lm = float(loss(jnp.asarray(row)))
+        numeric = (lp - lm) / (2 * epsilon)
+        a = float(analytic[i])
+        denom = abs(a) + abs(numeric)
+        rel = abs(a - numeric) / denom if denom > 0 else 0.0
+        max_rel = max(max_rel, rel)
+        if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+            fails += 1
+            print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} "
+                  f"rel={rel:.3g}")
+    print(f"checked {len(idxs)}/{n} params, max rel {max_rel:.3g}, "
+          f"{fails} failures")
+    return fails == 0
+
+
+def test_moe_top2_router_and_expert_gradients():
+    """MoE top-2 (GShard routing): numerical gradients must match the
+    analytic ones for BOTH the expert params and the router matrix — the
+    router grads flow through the renormalized surviving-choice weights."""
+    E, D, N = 4, 6, 16
+    mesh = make_mesh((E,), ("expert",), devices=jax.devices()[:E])
+    blocks = [{"W": jnp.asarray(R.normal(size=(D, D)) * 0.4, jnp.float64),
+               "b": jnp.asarray(R.normal(size=(D,)) * 0.1, jnp.float64)}
+              for _ in range(E)]
+    stacked = stack_stage_params(blocks)
+    router = jnp.asarray(R.normal(size=(D, E)) * 0.5, jnp.float64)
+    toks = jnp.asarray(R.normal(size=(N, D)), jnp.float64)
+    tgt = jnp.asarray(R.normal(size=(N, D)), jnp.float64)
+    moe = expert_parallel_apply(
+        lambda p, x: jnp.tanh(x @ p["W"] + p["b"]), mesh, "expert", top_k=2)
+
+    sizes = [(k, np.prod(v.shape)) for k, v in
+             [("W", stacked["W"]), ("b", stacked["b"]), ("r", router)]]
+
+    def unflatten(flat):
+        off = 0
+        out = {}
+        for k, sz in sizes:
+            ref = {"W": stacked["W"], "b": stacked["b"], "r": router}[k]
+            out[k] = flat[off:off + sz].reshape(ref.shape)
+            off += sz
+        return out
+
+    def loss(flat):
+        p = unflatten(flat)
+        logits = toks @ p["r"]
+        y = moe({"W": p["W"], "b": p["b"]}, toks, logits)
+        return 0.5 * jnp.sum((y - tgt) ** 2)
+
+    flat0 = np.concatenate([np.asarray(stacked["W"]).ravel(),
+                            np.asarray(stacked["b"]).ravel(),
+                            np.asarray(router).ravel()])
+    # check ALL router params (they're few and the interesting ones) plus a
+    # sample of expert params
+    n_router = router.size
+    assert _central_diff_check(loss, flat0, subset=60 + n_router)
+
+
+def test_pipeline_stack_gradients():
+    """GPipe pipeline over 4 stages: central differences through the
+    scan-scheduled microbatch pipeline must match jax.grad."""
+    S, D = 4, 5
+    mesh = make_mesh((S,), ("pipe",), devices=jax.devices()[:S])
+    blocks = [{"W": jnp.asarray(R.normal(size=(D, D)) * 0.4, jnp.float64),
+               "b": jnp.asarray(R.normal(size=(D,)) * 0.1, jnp.float64)}
+              for _ in range(S)]
+    stacked = stack_stage_params(blocks)
+    x_micro = jnp.asarray(R.normal(size=(4, 3, D)), jnp.float64)
+    tgt = jnp.asarray(R.normal(size=(4, 3, D)), jnp.float64)
+    pipe = pipeline_apply(lambda p, x: jnp.tanh(x @ p["W"] + p["b"]),
+                          mesh, "pipe")
+
+    shapes = [stacked["W"].shape, stacked["b"].shape]
+
+    def loss(flat):
+        w = flat[:np.prod(shapes[0])].reshape(shapes[0])
+        b = flat[np.prod(shapes[0]):].reshape(shapes[1])
+        y = pipe({"W": w, "b": b}, x_micro)
+        return 0.5 * jnp.sum((y - tgt) ** 2)
+
+    flat0 = np.concatenate([np.asarray(stacked["W"]).ravel(),
+                            np.asarray(stacked["b"]).ravel()])
+    assert _central_diff_check(loss, flat0, subset=60)
+
+
+def test_bidirectional_lstm_masked_gradients():
+    """GravesBidirectionalLSTM with variable-length masks, f64: the scan
+    twin of the fused kernel, numerically verified end-to-end through the
+    MLN loss (masked loss + masked eval; reference
+    GradientCheckTestsMasking)."""
+    T, V = 5, 3
+    conf = (NeuralNetConfiguration(seed=12345, updater=Sgd(0.1),
+                                   dtype="float64")
+            .list(GravesBidirectionalLSTM(n_out=6, activation="tanh"),
+                  RnnOutputLayer(n_out=V, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(V, T)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = R.normal(size=(4, T, V))
+    y = np.eye(V)[R.integers(0, V, (4, T))]
+    lens = np.asarray([2, 5, 3, 4])
+    m = (np.arange(T)[None, :] < lens[:, None]).astype(np.float64)
+    assert check_gradients(net, x, y, labels_mask=m, features_mask=m,
+                           print_results=True)

@@ -79,6 +79,31 @@ def test_subsampling_matches_numpy(pool):
     np.testing.assert_allclose(np.asarray(got), want, atol=1e-12)
 
 
+def test_avg_pool_same_divisor_semantics():
+    """SAME-mode avg pool, odd length: the reference (SubsamplingLayer.java
+    activate — mean over the full zero-padded im2col window) divides by
+    kernel-size everywhere; TF/Keras divides by the valid-cell count. The
+    flag selects; reference semantics is the default."""
+    x = np.abs(R.normal(size=(1, 5, 5, 1))).astype(np.float64) + 1.0
+    ref = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                           stride=(2, 2), convolution_mode="same")
+    tf_ = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                           stride=(2, 2), convolution_mode="same",
+                           avg_pool_include_pad_in_divisor=False)
+    got_ref, _ = ref.apply({}, {}, jnp.asarray(x))
+    got_tf, _ = tf_.apply({}, {}, jnp.asarray(x))
+    # interior windows agree ...
+    np.testing.assert_allclose(np.asarray(got_ref)[:, :2, :2],
+                               np.asarray(got_tf)[:, :2, :2], atol=1e-12)
+    # ... the corner window (1 valid cell of 4) differs by exactly 4x
+    np.testing.assert_allclose(np.asarray(got_tf)[0, 2, 2, 0],
+                               4.0 * np.asarray(got_ref)[0, 2, 2, 0],
+                               atol=1e-12)
+    # and the reference path equals sum/ (kh*kw) computed by hand
+    np.testing.assert_allclose(np.asarray(got_ref)[0, 2, 2, 0],
+                               x[0, 4, 4, 0] / 4.0, atol=1e-12)
+
+
 def test_batchnorm_matches_numpy():
     layer = BatchNormalization(n_out=4, activation="identity")
     params, state = layer.init(jax.random.PRNGKey(2), None, jnp.float64)

@@ -1,0 +1,157 @@
+# -*- coding: utf-8 -*-
+"""Build the bundled CJK dictionaries + held-out gold fixtures.
+
+Run from the repo root:  python tools/build_cjk_dicts.py
+
+Outputs (committed to the repo):
+  deeplearning4j_tpu/nlp/data/zh_dict.tsv
+      Simplified-Chinese lexicon derived from the jieba 0.42.1 package's
+      dict.txt (MIT License) installed in this image: entries with
+      freq >= ZH_MIN_FREQ, word length <= 8 — real corpus frequencies and
+      POS tags at real scale (tens of thousands of entries).
+  deeplearning4j_tpu/nlp/data/ja_dict.tsv
+      Japanese lexicon COMPILED (dict_build.compile_dictionary) from the
+      first 85%% of an ipadic-tokenized public-domain corpus (Natsume
+      Soseki's novel "Botchan", tokenized by kuromoji+mecab-ipadic; the
+      token stream ships as third-party test data in the reference repo).
+      Only (surface, top-level-POS) pairs are used — the compile step and
+      output format are ours.
+  tests/fixtures/ja_heldout_gold.json
+      Sentences reconstructed from the HELD-OUT last 15%% of the same token
+      stream (never seen by the dictionary build) with their gold token
+      sequences — the span-F1 eval set.
+  tests/fixtures/zh_gold_jieba.json
+      Chinese eval sentences with gold segmentation produced by jieba's
+      full 349k-entry dictionary (precise mode) — an independent segmenter,
+      so our dictionary/lattice is graded against an external standard, not
+      against the vocabulary it embeds.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nlp.dict_build import (compile_dictionary,
+                                               write_dict_tsv)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "deeplearning4j_tpu", "nlp", "data")
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+ZH_MIN_FREQ = 50
+JA_TRAIN_FRACTION = 0.85
+
+# Eval sentences for Chinese (drafted text; the GOLD segmentation comes
+# from jieba's full dictionary, not from any vocabulary we bundle)
+ZH_EVAL_SENTENCES = [
+    "今天的天气非常好，我们决定去公园散步。",
+    "人工智能技术正在改变世界经济的发展方向。",
+    "他昨天在北京大学参加了一个国际学术会议。",
+    "这家公司的产品质量得到了消费者的广泛认可。",
+    "政府宣布将加大对基础设施建设的投资力度。",
+    "科学家发现了一种新的治疗方法来对抗疾病。",
+    "随着互联网的普及，越来越多的人开始网上购物。",
+    "她每天早上六点起床，然后去附近的健身房锻炼身体。",
+    "中国的高速铁路网络已经成为世界上最大的铁路系统。",
+    "环境保护是当今社会面临的重要问题之一。",
+    "学生们正在图书馆里认真准备期末考试。",
+    "这部电影讲述了一个关于友谊和成长的感人故事。",
+    "经济学家预测明年的市场形势将会有所好转。",
+    "医生建议病人多喝水，注意休息，避免过度劳累。",
+    "新能源汽车的销量在过去五年里增长了十倍。",
+    "记者在现场采访了几位目击事故经过的群众。",
+    "历史博物馆收藏了大量珍贵的古代文物。",
+    "足球比赛在体育场举行，吸引了数万名观众。",
+    "软件工程师需要不断学习新的编程语言和技术。",
+    "春节期间，家家户户都会贴春联、吃饺子、放鞭炮。",
+]
+
+
+def build_zh():
+    import jieba  # MIT-licensed package installed in the image
+    src = os.path.join(os.path.dirname(jieba.__file__), "dict.txt")
+    entries = {}
+    with open(src, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            w, freq = parts[0], int(parts[1])
+            pos = parts[2] if len(parts) > 2 else ""
+            if freq >= ZH_MIN_FREQ and len(w) <= 8:
+                entries[w] = (freq, pos)
+    os.makedirs(DATA, exist_ok=True)
+    write_dict_tsv(entries, os.path.join(DATA, "zh_dict.tsv"), header=(
+        "Simplified-Chinese lexicon for the lattice segmenter.\n"
+        f"Derived from jieba 0.42.1 dict.txt (MIT License), freq >= "
+        f"{ZH_MIN_FREQ}.\nFormat: word<TAB>freq<TAB>pos"))
+    print(f"zh_dict.tsv: {len(entries)} entries")
+
+    # gold fixture from jieba's FULL dictionary (precise mode)
+    gold = [{"sentence": s, "tokens": [t for t in jieba.cut(s) if t.strip()]}
+            for s in ZH_EVAL_SENTENCES]
+    os.makedirs(FIXTURES, exist_ok=True)
+    with open(os.path.join(FIXTURES, "zh_gold_jieba.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"provenance": "gold = jieba 0.42.1 precise mode "
+                                 "(full 349k dict), an independent segmenter",
+                   "data": gold}, f, ensure_ascii=False, indent=1)
+    print(f"zh_gold_jieba.json: {len(gold)} sentences")
+
+
+def _read_ipadic_stream(path):
+    """(surface, top-POS) pairs from a kuromoji 'surface<TAB>features' dump;
+    sentence punctuation is kept (it segments the eval sentences)."""
+    toks = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line or "\t" not in line:
+                continue
+            surface, feats = line.split("\t", 1)
+            toks.append((surface, feats.split(",")[0]))
+    return toks
+
+
+def build_ja():
+    src = ("/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp-"
+           "japanese/src/test/resources/bocchan-ipadic-features.txt")
+    if not os.path.exists(src):
+        print(f"SKIP ja: corpus not available at {src}")
+        return
+    toks = _read_ipadic_stream(src)
+    cut = int(len(toks) * JA_TRAIN_FRACTION)
+    train, heldout = toks[:cut], toks[cut:]
+    entries = compile_dictionary(train, min_freq=1, max_word_len=10)
+    os.makedirs(DATA, exist_ok=True)
+    write_dict_tsv(entries, os.path.join(DATA, "ja_dict.tsv"), header=(
+        "Japanese lexicon for the lattice segmenter.\n"
+        "Compiled (deeplearning4j_tpu.nlp.dict_build) from the first 85% of\n"
+        "the public-domain novel 'Botchan' (Natsume Soseki) tokenized with\n"
+        "kuromoji + mecab-ipadic (ipadic license: BSD-style).\n"
+        "Format: word<TAB>freq<TAB>pos"))
+    print(f"ja_dict.tsv: {len(entries)} entries from {len(train)} tokens")
+
+    # held-out gold: reconstruct sentences from the UNSEEN tail
+    sents, cur = [], []
+    for surface, pos in heldout:
+        cur.append(surface)
+        if surface in ("。", "？", "！"):
+            if 4 <= len(cur) <= 60:
+                sents.append(cur)
+            cur = []
+    sents = sents[:80]
+    gold = [{"sentence": "".join(t), "tokens": t} for t in sents]
+    with open(os.path.join(FIXTURES, "ja_heldout_gold.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"provenance": "held-out last 15% of the Botchan ipadic "
+                                 "token stream (never seen by the dictionary "
+                                 "build); gold = kuromoji+mecab-ipadic",
+                   "data": gold}, f, ensure_ascii=False, indent=1)
+    print(f"ja_heldout_gold.json: {len(gold)} sentences "
+          f"from {len(heldout)} held-out tokens")
+
+
+if __name__ == "__main__":
+    build_zh()
+    build_ja()

@@ -131,5 +131,16 @@ def auto_preprocessor(itype, expected: str):
         if isinstance(itype, InputTypeFeedForward):
             raise ValueError("Cannot feed FF input to an RNN layer without an "
                              "explicit FeedForwardToRnnPreProcessor")
+        if isinstance(itype, (InputTypeConvolutional, InputTypeConvolutionalFlat)):
+            # the time axis is ambiguous for a plain image: CNN->RNN is the
+            # video pipeline (T folded into batch) and needs the explicit
+            # CnnToRnnPreProcessor(h, w, c, timestep_length) — silently
+            # guessing here would mispredict every downstream shape
+            raise ValueError(
+                "Cannot feed CNN activations to an RNN layer without an "
+                "explicit CnnToRnnPreProcessor(height, width, channels, "
+                "timestep_length): the time dimension is ambiguous "
+                "(reference InputTypeUtil CNN->RNN is the time-distributed "
+                "video seam)")
         return None, itype
     return None, itype

@@ -47,77 +47,36 @@ _PAGE = """<!DOCTYPE html>
 
 
 def _svg_line_chart(series: List[tuple], width=720, height=220, logy=False):
-    """series: [(label, [(x, y), ...])]. Hand-rolled SVG polyline chart."""
+    """series: [(label, [(x, y), ...])]. Delegates to the component DSL's
+    ChartLine (ui/components.py) — one palette/scale/legend implementation
+    for the whole package; non-finite points are dropped there."""
+    from .components import ChartLine
     pts_all = [p for _, pts in series for p in pts]
     if not pts_all:
         return "<p class='meta'>no data yet</p>"
-    xs = [p[0] for p in pts_all]
-    ys = [p[1] for p in pts_all if p[1] is not None and math.isfinite(p[1])]
-    if not ys:
+    if not any(p[1] is not None and math.isfinite(p[1]) for p in pts_all):
         return "<p class='meta'>no finite data</p>"
-    x0, x1 = min(xs), max(xs)
-    y0, y1 = min(ys), max(ys)
-    if x1 == x0:
-        x1 = x0 + 1
-    if y1 == y0:
-        y1 = y0 + (abs(y0) if y0 else 1) * 0.1 + 1e-12
-    pad = 40
-    W, H = width - pad - 10, height - 30
-    colors = ["#2563eb", "#dc2626", "#059669", "#d97706", "#7c3aed",
-              "#0891b2", "#be185d", "#4d7c0f", "#b91c1c", "#1e40af"]
-
-    def sx(x):
-        return pad + (x - x0) / (x1 - x0) * W
-
-    def sy(y):
-        return 5 + (1 - (y - y0) / (y1 - y0)) * H
-
-    parts = [f'<svg width="{width}" height="{height}" '
-             f'xmlns="http://www.w3.org/2000/svg">']
-    # axes + gridlines
-    for i in range(5):
-        gy = 5 + i * H / 4
-        val = y1 - i * (y1 - y0) / 4
-        parts.append(f'<line x1="{pad}" y1="{gy:.1f}" x2="{width-10}" '
-                     f'y2="{gy:.1f}" stroke="#eee"/>')
-        parts.append(f'<text x="2" y="{gy+3:.1f}">{val:.3g}</text>')
-    parts.append(f'<text x="{pad}" y="{height-5}">{x0:g}</text>')
-    parts.append(f'<text x="{width-60}" y="{height-5}">{x1:g}</text>')
-    legend_x = pad
-    for i, (label, pts) in enumerate(series):
-        c = colors[i % len(colors)]
-        poly = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts
-                        if y is not None and math.isfinite(y))
-        parts.append(f'<polyline fill="none" stroke="{c}" stroke-width="1.5" '
-                     f'points="{poly}"/>')
-        if len(series) > 1:
-            parts.append(f'<rect x="{legend_x}" y="{height-24}" width="8" '
-                         f'height="8" fill="{c}"/>')
-            parts.append(f'<text x="{legend_x+11}" y="{height-16}">{label}</text>')
-            legend_x += 11 + 7 * len(label) + 14
-    parts.append("</svg>")
-    return "".join(parts)
+    chart = ChartLine(
+        x=[[p[0] for p in pts] for _, pts in series],
+        y=[[p[1] for p in pts] for _, pts in series],
+        series_names=[label for label, _ in series],
+        width=width, height=height)
+    return chart.render()
 
 
 def _svg_histogram(hist: dict, width=340, height=120):
+    """hist: {counts, lo, hi}. Delegates to the DSL's ChartHistogram."""
+    from .components import ChartHistogram
     counts = hist.get("counts", [])
     if not counts:
         return ""
     lo, hi = hist.get("lo", 0.0), hist.get("hi", 1.0)
-    mx = max(counts) or 1
     n = len(counts)
-    pad, W, H = 4, width - 8, height - 22
-    bw = W / n
-    parts = [f'<svg width="{width}" height="{height}" '
-             f'xmlns="http://www.w3.org/2000/svg">']
-    for i, c in enumerate(counts):
-        h = c / mx * H
-        parts.append(f'<rect x="{pad+i*bw:.1f}" y="{4+H-h:.1f}" '
-                     f'width="{max(bw-1,1):.1f}" height="{h:.1f}" fill="#2563eb"/>')
-    parts.append(f'<text x="{pad}" y="{height-6}">{lo:.3g}</text>')
-    parts.append(f'<text x="{width-50}" y="{height-6}">{hi:.3g}</text>')
-    parts.append("</svg>")
-    return "".join(parts)
+    w = (hi - lo) / n if n else 1.0
+    return ChartHistogram(
+        lower_bounds=[lo + i * w for i in range(n)],
+        upper_bounds=[lo + (i + 1) * w for i in range(n)],
+        y=[float(c) for c in counts], width=width, height=height).render()
 
 
 def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,

@@ -171,12 +171,19 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 
     if jnp.dtype(net.conf.dtype) != jnp.float64:
         raise ValueError("Gradient checks require dtype='float64' "
                          "(reference enforces DataBuffer.Type.DOUBLE)")
-    x = jnp.asarray(x, jnp.float64)
-    y = jnp.asarray(y, jnp.float64)
+
+    def as64(v):
+        # multi-input/multi-output graphs pass lists of arrays
+        if isinstance(v, (list, tuple)):
+            return [jnp.asarray(a, jnp.float64) for a in v]
+        return jnp.asarray(v, jnp.float64)
+
+    x = as64(x)
+    y = as64(y)
     if labels_mask is not None:
-        labels_mask = jnp.asarray(labels_mask, jnp.float64)
+        labels_mask = as64(labels_mask)
     if features_mask is not None:
-        features_mask = jnp.asarray(features_mask, jnp.float64)
+        features_mask = as64(features_mask)
 
     # NOTE: deliberately NOT jitted. XLA fusion algebraically rewrites
     # compositions like log(sigmoid(x)) with ~1e-9 relative error — harmless

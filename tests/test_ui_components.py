@@ -1,0 +1,111 @@
+"""Report-component DSL (reference deeplearning4j-ui-components): JSON
+round-trip, server-side SVG/HTML rendering, and stats->report assembly."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import components as C
+
+
+def _full_tree():
+    return C.ComponentDiv(components=[
+        C.ComponentText("Report", size=18, bold=True),
+        C.ChartLine(title="loss", x=[[0, 1, 2], [0, 1, 2]],
+                    y=[[3.0, 2.0, 1.0], [2.5, 2.4, 2.2]],
+                    series_names=["train", "val"]),
+        C.ChartScatter(title="emb", x=[[0.0, 1.0]], y=[[1.0, 0.0]],
+                       series_names=["pts"]),
+        C.ChartHistogram(title="w", lower_bounds=[0.0, 0.5],
+                         upper_bounds=[0.5, 1.0], y=[3.0, 7.0]),
+        C.ChartHorizontalBar(title="f1", labels=["class0", "class1"],
+                             values=[0.9, 0.7]),
+        C.ChartStackedArea(title="mem", x=[0, 1, 2],
+                           y=[[1, 1, 1], [2, 1, 0]],
+                           series_names=["activations", "params"]),
+        C.ChartTimeline(title="steps", lane_names=["device"],
+                        lane_entries=[[[0, 5, "fwd"], [5, 9, "bwd"]]]),
+        C.ComponentTable(header=["metric", "value"],
+                         content=[["acc", "0.97"], ["f1", "0.95"]]),
+        C.DecoratorAccordion(title="details", default_collapsed=False,
+                             components=[C.ComponentText("inner <txt>")]),
+    ])
+
+
+def test_json_round_trip_all_types():
+    page = _full_tree()
+    j = page.to_json()
+    back = C.from_json(j)
+    assert back.to_json() == j
+    # every registered type appears in the payload
+    for name in ("ChartLine", "ChartScatter", "ChartHistogram",
+                 "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline",
+                 "ComponentTable", "ComponentText", "ComponentDiv",
+                 "DecoratorAccordion"):
+        assert name in j
+
+
+def test_render_html_is_self_contained_and_escaped():
+    html = C.render_html(_full_tree())
+    assert html.startswith("<!DOCTYPE html>")
+    assert "<svg" in html and "<table>" in html and "<details open>" in html
+    assert "&lt;txt&gt;" in html          # text content is escaped
+    assert "<script" not in html          # no JS dependency
+
+
+def test_unknown_type_raises():
+    with pytest.raises(ValueError, match="Unknown component"):
+        C.from_json('{"component_type": "ChartBogus"}')
+
+
+def test_training_report_from_stats():
+    """End-to-end: train with a StatsListener (histograms on), assemble the
+    component report, render it."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       StatsUpdateConfiguration)
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration(seed=1, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(
+        storage, config=StatsUpdateConfiguration(collect_histograms=True)))
+    net.fit(x, y, epochs=4, batch_size=32)
+
+    report = C.training_report(storage)
+    j = report.to_json()
+    assert "score vs iteration" in j and "ChartHistogram" in j
+    html = C.render_html(C.from_json(j))
+    assert "<svg" in html and "Training report" in html
+
+
+def test_attribute_injection_is_escaped():
+    html = C.render_html(C.ComponentText(
+        "hi", color="#111' onmouseover='alert(1)"))
+    assert "onmouseover='alert" not in html
+    assert "&#39;" in html
+
+
+def test_non_finite_points_do_not_poison_chart():
+    chart = C.ChartLine(title="s", x=[[0, 1, 2, 3]],
+                        y=[[1.0, float("nan"), 2.0, float("inf")]],
+                        series_names=["loss"])
+    svg = chart.render()
+    assert "nan" not in svg and "inf" not in svg
+    assert "polyline" in svg
+
+
+def test_dashboard_delegates_to_dsl():
+    from deeplearning4j_tpu.ui.dashboard import (_svg_histogram,
+                                                 _svg_line_chart)
+    out = _svg_line_chart([("a", [(0, 1.0), (1, float("nan")), (2, 2.0)])])
+    assert "<svg" in out and "nan" not in out
+    assert _svg_line_chart([("a", [])]) == "<p class='meta'>no data yet</p>"
+    h = _svg_histogram({"counts": [1, 3, 2], "lo": -1.0, "hi": 1.0})
+    assert "<svg" in h and h.count("<rect") == 3

@@ -69,13 +69,19 @@ class SelfAttentionLayer(LayerConf):
         return x.reshape(B, T, self.n_heads, -1).transpose(0, 2, 1, 3)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from ...ops.pallas_attention import (flash_attention,
+                                             fused_attention_applicable)
         from ...parallel.ring_attention import attention
         x = maybe_dropout(x, self.dropout, rng, train)
         q = self._heads(x @ params["Wq"])
         k = self._heads(x @ params["Wk"])
         v = self._heads(x @ params["Wv"])
-        out = attention(q, k, v, causal=self.causal, key_mask=mask)
-        B, H, T, Dh = out.shape
+        B, H, T, Dh = q.shape
+        if fused_attention_applicable(B, H, T, Dh, q.dtype):
+            # fused Pallas path: O(T) HBM traffic (ops/pallas_attention.py)
+            out = flash_attention(q, k, v, causal=self.causal, key_mask=mask)
+        else:
+            out = attention(q, k, v, causal=self.causal, key_mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
         if self.project_out:
             out = out @ params["Wo"] + params["b"]

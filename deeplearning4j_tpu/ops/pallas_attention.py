@@ -1,0 +1,368 @@
+"""Fused flash-attention Pallas kernels (TPU).
+
+Net-new beyond the reference (its sequence story is LSTM-only — SURVEY.md
+§5.7): the O(T) HBM-traffic attention primitive that makes long contexts
+first-class. The XLA path (parallel/ring_attention.attention) materializes
+the [B,H,T,T] score tensor in HBM; these kernels keep each [BQ,BK] score
+block in VMEM with the online-softmax recurrence, so HBM traffic is
+O(B*H*T*D) regardless of T.
+
+Design (same helper-probe-with-fallback seam as ops/pallas_lstm.py):
+  - forward: grid (B*H, T/BQ, T/BK), k-blocks innermost ("arbitrary"
+    semantics) with the (acc, m, l) carry in VMEM scratch; saves the
+    logsumexp rows for the backward.
+  - backward (FlashAttention-2 style, custom_vjp): one kernel accumulates
+    dq over k-blocks, a second accumulates (dk, dv) over q-blocks; softmax
+    probabilities are recomputed from the saved logsumexp, never stored.
+  - causal blocks strictly above the diagonal are skipped (@pl.when), so
+    causal attention does ~half the work.
+  - masking uses a large negative (-1e30) everywhere, matching the XLA
+    fallback: a fully-masked query row degrades to uniform attention
+    instead of NaN.
+  - bf16 i/o supported; compute is f32 in-kernel.
+
+lse/delta are carried as [BH, T, 128] lane-replicated f32 (the standard
+layout trick: per-row scalars live on all 128 lanes so no sub-tile
+transposes are needed).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    PALLAS_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PALLAS_AVAILABLE = False
+
+f32 = jnp.float32
+NEG = -1e30
+
+
+def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
+    """Probe: can the fused kernels handle this call? (helper seam —
+    callers fall back to the XLA path when False)."""
+    if not PALLAS_AVAILABLE:
+        return False
+    if os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") == "0":
+        return False
+    dt = jnp.dtype(dtype)
+    if dt not in (jnp.float32, jnp.dtype(jnp.bfloat16)):
+        return False
+    if D % 128 != 0 or T % 128 != 0 or T < 256:
+        # D is the lane dimension (must tile by 128); tiny T isn't worth
+        # the pallas_call overhead vs one fused XLA softmax
+        return False
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend == "cpu":
+        # interpreter is for parity tests only (see ops/pallas_lstm.py)
+        return os.environ.get("DL4J_TPU_FUSED_ATTN_INTERPRET", "0") == "1"
+    return False
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(T: int) -> int:
+    for b in (512, 256, 128):
+        if T % b == 0:
+            return b
+    raise ValueError(f"T={T} not a multiple of 128")
+
+
+def _causal_mask_block(i, j, BQ, BK, s):
+    row = i * BQ + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    col = j * BK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(col <= row, s, NEG)
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_body(causal, masked, scale, BQ, BK, *refs):
+    if masked:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc, m, l = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l = refs
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, NEG)
+        l[:] = jnp.zeros_like(l)
+
+    compute = True if not causal else (j * BK < (i + 1) * BQ)
+
+    @pl.when(compute)
+    def _update():
+        q = q_ref[0].astype(f32)
+        k = k_ref[0].astype(f32)
+        v = v_ref[0].astype(f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=f32) * scale
+        if causal:
+            s = _causal_mask_block(i, j, BQ, BK, s)
+        if masked:
+            s = jnp.where(mask_ref[0][0:1, :] > 0, s, NEG)
+        m_prev = m[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l[:] = jnp.broadcast_to(l[:, :1] * corr + p.sum(1, keepdims=True),
+                                l.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+        m[:] = jnp.broadcast_to(m_new, m.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[0] = (acc[:] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m[:] + jnp.log(l[:])
+
+
+def _fwd(q3, k3, v3, mask2, causal, scale):
+    """q3/k3/v3: [BH, T, D]; mask2: [B, T] or None. Returns (o, lse)."""
+    BH, T, D = q3.shape
+    BQ = BK = _block(T)
+    grid = (BH, T // BQ, T // BK)
+    in_specs = [
+        pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q3, k3, v3]
+    masked = mask2 is not None
+    if masked:
+        H = BH // mask2.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, BK), lambda b, i, j: (b // H, 0, j)))
+        args.append(mask2[:, None, :].astype(f32))
+    out_shape = [jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+                 jax.ShapeDtypeStruct((BH, T, 128), f32)]
+    out_specs = [pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+                 pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0))]
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_body, causal, masked, scale, BQ, BK),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((BQ, D), f32),
+                        pltpu.VMEM((BQ, 128), f32),
+                        pltpu.VMEM((BQ, 128), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return o, lse
+
+
+# ------------------------------------------------------------------ dq pass
+def _dq_body(causal, masked, scale, BQ, BK, *refs):
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    compute = True if not causal else (j * BK < (i + 1) * BQ)
+
+    @pl.when(compute)
+    def _update():
+        q = q_ref[0].astype(f32)
+        k = k_ref[0].astype(f32)
+        v = v_ref[0].astype(f32)
+        do = do_ref[0].astype(f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=f32) * scale
+        if causal:
+            s = _causal_mask_block(i, j, BQ, BK, s)
+        if masked:
+            s = jnp.where(mask_ref[0][0:1, :] > 0, s, NEG)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=f32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=f32)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------- dkv pass
+def _dkv_body(causal, masked, scale, BQ, BK, *refs):
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    jk = pl.program_id(1)          # k-block (outer)
+    i = pl.program_id(2)           # q-block (inner, "arbitrary")
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    compute = True if not causal else ((i + 1) * BQ > jk * BK)
+
+    @pl.when(compute)
+    def _update():
+        q = q_ref[0].astype(f32)
+        k = k_ref[0].astype(f32)
+        v = v_ref[0].astype(f32)
+        do = do_ref[0].astype(f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=f32) * scale
+        if causal:
+            s = _causal_mask_block(i, jk, BQ, BK, s)
+        if masked:
+            s = jnp.where(mask_ref[0][0:1, :] > 0, s, NEG)
+        p = jnp.exp(s - lse_ref[0][:, :1])                    # [BQ, BK]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=f32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3):
+    BH, T, D = q3.shape
+    BQ = BK = _block(T)
+    masked = mask2 is not None
+    # delta = rowsum(dO * O), lane-replicated like lse
+    delta = jnp.sum(do3.astype(f32) * o3.astype(f32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (BH, T, 128))
+
+    common_args = [q3, k3, v3, do3, lse, delta]
+    qspec = pl.BlockSpec((1, BQ, D), lambda b, x, y: (b, x, 0))
+
+    def q_side(which):
+        # index maps for the dq grid (b, i, j): q-indexed rows use i
+        return {
+            "q": pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            "k": pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            "v": pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0)),
+            "do": pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            "lse": pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+            "delta": pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0)),
+        }[which]
+
+    in_specs = [q_side(n) for n in ("q", "k", "v", "do", "lse", "delta")]
+    args = list(common_args)
+    if masked:
+        H = BH // mask2.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, BK), lambda b, i, j: (b // H, 0, j)))
+        args.append(mask2[:, None, :].astype(f32))
+    dq = pl.pallas_call(
+        functools.partial(_dq_body, causal, masked, scale, BQ, BK),
+        grid=(BH, T // BQ, T // BK),
+        in_specs=in_specs,
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, D), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BQ, D), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)[0]
+
+    # dkv grid is (b, jk, i): q-indexed rows use the INNER index i
+    def kv_side(which):
+        return {
+            "q": pl.BlockSpec((1, BQ, D), lambda b, jk, i: (b, i, 0)),
+            "k": pl.BlockSpec((1, BK, D), lambda b, jk, i: (b, jk, 0)),
+            "v": pl.BlockSpec((1, BK, D), lambda b, jk, i: (b, jk, 0)),
+            "do": pl.BlockSpec((1, BQ, D), lambda b, jk, i: (b, i, 0)),
+            "lse": pl.BlockSpec((1, BQ, 128), lambda b, jk, i: (b, i, 0)),
+            "delta": pl.BlockSpec((1, BQ, 128), lambda b, jk, i: (b, i, 0)),
+        }[which]
+
+    in_specs = [kv_side(n) for n in ("q", "k", "v", "do", "lse", "delta")]
+    args = list(common_args)
+    if masked:
+        H = BH // mask2.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, BK), lambda b, jk, i: (b // H, 0, jk)))
+        args.append(mask2[:, None, :].astype(f32))
+    kvspec = pl.BlockSpec((1, BK, D), lambda b, jk, i: (b, jk, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_body, causal, masked, scale, BQ, BK),
+        grid=(BH, T // BK, T // BQ),
+        in_specs=in_specs,
+        out_specs=[kvspec, kvspec],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, D), k3.dtype),
+                   jax.ShapeDtypeStruct((BH, T, D), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BK, D), f32),
+                        pltpu.VMEM((BK, D), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q3, k3, v3, mask2, causal, scale):
+    o, _ = _fwd(q3, k3, v3, mask2, causal, scale)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, mask2, causal, scale):
+    o, lse = _fwd(q3, k3, v3, mask2, causal, scale)
+    return o, (q3, k3, v3, mask2, o, lse)
+
+
+def _flash_bwd(causal, scale, res, do3):
+    q3, k3, v3, mask2, o3, lse = res
+    dq, dk, dv = _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, key_mask=None):
+    """Fused softmax attention, [B,H,T,D] in/out — drop-in for
+    parallel/ring_attention.attention when fused_attention_applicable.
+    ``key_mask`` [B,T] excludes padded timesteps as keys."""
+    B, H, T, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    q3 = q.reshape(B * H, T, D)
+    k3 = k.reshape(B * H, T, D)
+    v3 = v.reshape(B * H, T, D)
+    mask2 = None if key_mask is None else jnp.asarray(key_mask)
+    o = _flash(q3, k3, v3, mask2, causal, scale)
+    return o.reshape(B, H, T, D)

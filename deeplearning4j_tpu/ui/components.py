@@ -239,11 +239,15 @@ class ChartHistogram(Component):
     height: int = 200
 
     def render(self) -> str:
-        xs = self.lower_bounds + self.upper_bounds
-        ys = [0.0] + list(self.y)
+        # drop non-finite bins entirely (same contract as ChartLine)
+        bins = [(lo, hi, cnt) for lo, hi, cnt in
+                zip(self.lower_bounds, self.upper_bounds, self.y)
+                if _finite(lo) and _finite(hi) and _finite(cnt)]
+        xs = [b[0] for b in bins] + [b[1] for b in bins]
+        ys = [0.0] + [b[2] for b in bins]
         sx, sy, lims, dims = _scales(xs, ys, self.width, self.height)
         parts = _grid(sx, sy, lims, dims, self.width, self.height)
-        for lo, hi, cnt in zip(self.lower_bounds, self.upper_bounds, self.y):
+        for lo, hi, cnt in bins:
             x0p, x1p = sx(lo), sx(hi)
             parts.append(
                 f'<rect x="{x0p:.1f}" y="{sy(cnt):.1f}" '
@@ -292,22 +296,29 @@ class ChartStackedArea(Component):
     height: int = 240
 
     def render(self) -> str:
-        if not self.x or not self.y:
+        # a non-finite value in ANY band poisons the whole stacked column
+        # (bands accumulate), so drop those columns entirely
+        cols = [t for t in range(len(self.x))
+                if _finite(self.x[t]) and all(_finite(band[t])
+                                              for band in self.y)]
+        if not cols or not self.y:
             return _chart_frame(self.title, self.width, self.height, "")
+        x = [self.x[t] for t in cols]
+        bands = [[band[t] for t in cols] for band in self.y]
         stacked = []
-        run = [0.0] * len(self.x)
-        for band in self.y:
+        run = [0.0] * len(x)
+        for band in bands:
             run = [a + b for a, b in zip(run, band)]
             stacked.append(list(run))
-        sx, sy, lims, dims = _scales(self.x, [0.0] + stacked[-1],
+        sx, sy, lims, dims = _scales(x, [0.0] + stacked[-1],
                                      self.width, self.height)
         parts = _grid(sx, sy, lims, dims, self.width, self.height)
-        prev = [0.0] * len(self.x)
+        prev = [0.0] * len(x)
         for i, top in enumerate(stacked):
             c = _COLORS[i % len(_COLORS)]
-            fwd = [f"{sx(a):.1f},{sy(b):.1f}" for a, b in zip(self.x, top)]
+            fwd = [f"{sx(a):.1f},{sy(b):.1f}" for a, b in zip(x, top)]
             back = [f"{sx(a):.1f},{sy(b):.1f}"
-                    for a, b in zip(reversed(self.x), reversed(prev))]
+                    for a, b in zip(reversed(x), reversed(prev))]
             parts.append(f'<polygon fill="{c}" fill-opacity="0.55" '
                          f'stroke="{c}" points="{" ".join(fwd + back)}"/>')
             prev = top

@@ -12,9 +12,10 @@ import os
 # must run on the virtual 8-device CPU platform (SURVEY.md §4: the analogue
 # of the reference's Spark local[n] testing).
 os.environ["JAX_PLATFORMS"] = "cpu"
-# Parity tests exercise the fused Pallas LSTM via the interpreter on CPU;
-# production CPU runs take the (much faster) scan fallback instead.
+# Parity tests exercise the fused Pallas LSTM/attention via the interpreter
+# on CPU; production CPU runs take the (much faster) XLA fallbacks instead.
 os.environ.setdefault("DL4J_TPU_FUSED_LSTM_INTERPRET", "1")
+os.environ.setdefault("DL4J_TPU_FUSED_ATTN_INTERPRET", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

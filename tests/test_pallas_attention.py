@@ -1,0 +1,132 @@
+"""Fused flash-attention kernels (ops/pallas_attention.py): parity against
+the XLA reference path (parallel/ring_attention.attention) across
+causal x mask x dtype, gradients included, plus the layer-level seam.
+Interpreter mode on CPU (conftest sets DL4J_TPU_FUSED_ATTN_INTERPRET)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.pallas_attention import (flash_attention,
+                                                     fused_attention_applicable)
+from deeplearning4j_tpu.parallel.ring_attention import attention
+
+R = np.random.default_rng(11)
+B, H, T, D = 2, 2, 256, 128
+
+
+def _qkv(dtype=jnp.float32):
+    return tuple(jnp.asarray(R.normal(size=(B, H, T, D)), dtype)
+                 for _ in range(3))
+
+
+def _mask():
+    lens = R.integers(T // 4, T, B)
+    return jnp.asarray((np.arange(T)[None, :] < lens[:, None])
+                       .astype(np.float32))
+
+
+def test_applicability_probe():
+    assert fused_attention_applicable(B, H, T, D, jnp.float32)
+    assert fused_attention_applicable(B, H, T, D, jnp.bfloat16)
+    assert not fused_attention_applicable(B, H, T, 64, jnp.float32)   # D%128
+    assert not fused_attention_applicable(B, H, 200, D, jnp.float32)  # T%128
+    assert not fused_attention_applicable(B, H, 128, D, jnp.float32)  # tiny T
+    assert not fused_attention_applicable(B, H, T, D, jnp.float64)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_forward_parity(causal, masked):
+    q, k, v = _qkv()
+    km = _mask() if masked else None
+    ours = flash_attention(q, k, v, causal=causal, key_mask=km)
+    ref = attention(q, k, v, causal=causal, key_mask=km)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_gradient_parity_causal_masked():
+    q, k, v = _qkv()
+    km = _mask()
+
+    def lf(fn):
+        def loss(q, k, v):
+            out = fn(q, k, v, causal=True, key_mask=km)
+            return jnp.sum(out * out)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    g_fused = lf(flash_attention)
+    g_ref = lf(attention)
+    for name, a, b in zip("qkv", g_fused, g_ref):
+        rel = (float(jnp.max(jnp.abs(a - b)))
+               / (float(jnp.max(jnp.abs(b))) + 1e-9))
+        assert rel < 1e-4, (name, rel)
+
+
+def test_bf16_io_close_to_f32():
+    qf, kf, vf = _qkv(jnp.float32)
+    q, k, v = (a.astype(jnp.bfloat16) for a in (qf, kf, vf))
+    out_bf = flash_attention(q, k, v, causal=True)
+    out_f = flash_attention(qf, kf, vf, causal=True)
+    assert out_bf.dtype == jnp.bfloat16
+    # f32 in-kernel compute: error is bf16 i/o rounding, not compounding
+    np.testing.assert_allclose(np.asarray(out_bf, np.float32),
+                               np.asarray(out_f), atol=0.05)
+
+
+def test_fully_masked_row_is_uniform_not_nan():
+    q, k, v = _qkv()
+    km = jnp.zeros((B, T), jnp.float32)     # everything masked
+    out = flash_attention(q, k, v, key_mask=km)
+    ref = jnp.mean(v, axis=2, keepdims=True)  # uniform attention
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out),
+                               np.broadcast_to(np.asarray(ref), out.shape),
+                               atol=2e-5)
+
+
+def test_layer_routes_through_fused_path(monkeypatch):
+    """SelfAttentionLayer parity fused-vs-XLA through the layer seam
+    (Dh = n_out/n_heads = 128 makes the probe pass)."""
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import SelfAttentionLayer
+
+    layer = SelfAttentionLayer(n_in=16, n_out=256, n_heads=2, causal=True)
+    params, state = layer.init(jax.random.PRNGKey(0),
+                               InputType.recurrent(16, T), jnp.float32)
+    x = jnp.asarray(R.normal(size=(2, T, 16)), jnp.float32)
+    outs = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("DL4J_TPU_FUSED_ATTENTION", flag)
+        out, _ = layer.apply(params, state, x)
+        outs[flag] = np.asarray(out)
+    np.testing.assert_allclose(outs["1"], outs["0"], atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_block_grid_parity(causal):
+    """T=768 -> _block(768)=256 -> a 3x3 block grid: exercises the
+    online-softmax (acc,m,l) rescale carry across k-blocks, the causal
+    block-skip predicate, and cross-block dq/dkv accumulation — logic a
+    single-block T=256 test never touches. Interpreter mode = f32-exact."""
+    T2 = 768
+    q, k, v = (jnp.asarray(R.normal(size=(1, 2, T2, 128)), jnp.float32)
+               for _ in range(3))
+    lens = R.integers(T2 // 4, T2, 1)
+    km = jnp.asarray((np.arange(T2)[None, :] < lens[:, None])
+                     .astype(np.float32))
+    for mask in (None, km):
+        ours = flash_attention(q, k, v, causal=causal, key_mask=mask)
+        ref = attention(q, k, v, causal=causal, key_mask=mask)
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                                   atol=3e-5,
+                                   err_msg=f"mask={mask is not None}")
+
+    def lf(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=causal, key_mask=km) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", lf(flash_attention), lf(attention)):
+        rel = (float(jnp.max(jnp.abs(a - b)))
+               / (float(jnp.max(jnp.abs(b))) + 1e-9))
+        assert rel < 1e-4, (name, rel)

@@ -109,3 +109,17 @@ def test_dashboard_delegates_to_dsl():
     assert _svg_line_chart([("a", [])]) == "<p class='meta'>no data yet</p>"
     h = _svg_histogram({"counts": [1, 3, 2], "lo": -1.0, "hi": 1.0})
     assert "<svg" in h and h.count("<rect") == 3
+
+
+def test_non_finite_filtering_stacked_area_and_histogram():
+    sa = C.ChartStackedArea(x=[0, 1, 2], y=[[1.0, float("nan"), 1.0],
+                                            [2.0, 1.0, float("inf")]],
+                            series_names=["a", "b"])
+    svg = sa.render()
+    assert "nan" not in svg and "inf" not in svg and "polygon" in svg
+    h = C.ChartHistogram(lower_bounds=[0.0, 1.0, 2.0],
+                         upper_bounds=[1.0, 2.0, 3.0],
+                         y=[3.0, float("nan"), 2.0])
+    svg = h.render()
+    assert "nan" not in svg
+    assert svg.count("<rect") >= 2   # the two finite bins still draw

@@ -127,6 +127,20 @@ def _invalid_row(items_per_step, flops_per_step, reason):
             "estimate_kind": f"roofline_upper_bound@{MAX_PLAUSIBLE_MFU:.0%}_mfu"}
 
 
+def _readback_barrier(tree):
+    """Force ACTUAL device completion of every leaf of ``tree`` and return
+    a float. block_until_ready is not a reliable barrier on this rig (the
+    tunnel can mark futures ready before the device finishes); fetching a
+    value is. One scalar per leaf is read, so the transfer cost is a single
+    ~100ms RTT regardless of model size."""
+    import jax
+    import jax.numpy as jnp
+    total = 0.0
+    for leaf in jax.tree.leaves(tree):
+        total += float(np.asarray(jnp.ravel(jnp.asarray(leaf))[0]))
+    return total
+
+
 def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
     """True DEVICE time per training step, measured as the slope between two
     fori_loop repetition counts inside single jitted calls.
@@ -248,13 +262,6 @@ def _guarded_rate(step_xc, x, carry, *, items_per_step, label, steps=STEPS):
     jitted = jax.jit(step_xc, donate_argnums=(1,))
     runner, flops = _aot(jitted, [x, carry])
 
-    import jax.numpy as jnp
-
-    def readback(st):
-        # scalar fetch = the only completion barrier this tunnel honors
-        leaf = jax.tree.leaves(st)[0]
-        return float(np.asarray(jnp.ravel(leaf)[0]))
-
     state = carry
     for _ in range(WARMUP):
         state = runner(x, state)
@@ -275,7 +282,7 @@ def _guarded_rate(step_xc, x, carry, *, items_per_step, label, steps=STEPS):
     t0 = time.perf_counter()
     for _ in range(steps):
         state = runner(x, state)
-    readback(state)
+    _readback_barrier(state)
     wall = time.perf_counter() - t0
     lied = wall > 1.5 * (dt * steps) + 0.5
 
@@ -549,9 +556,8 @@ def bench_piped(batch=128):
                 carry = list(step(*carry, x, y))
                 n += 1
             # value readback: the completion barrier this tunnel honors
-            # (block_until_ready can return early); scalar fetch, so the
-            # cost is one RTT per epoch
-            float(np.asarray(jnp.ravel(jax.tree.leaves(carry[0])[0])[0]))
+            # (block_until_ready can return early; cost: one RTT per epoch)
+            _readback_barrier(carry)
             return n, carry
 
         n, carry = run_epoch(carry)   # warmup epoch: compile + page cache

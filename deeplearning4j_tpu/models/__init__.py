@@ -2,10 +2,11 @@ from .lenet import lenet
 from .pretrained import adler32_of, fetch_cached, init_pretrained
 from .zoo import alexnet, resnet50, simple_cnn, vgg16, vgg19
 from .zoo_extra import (facenet_nn4_small2, googlenet, inception_resnet_v1,
-                        text_generation_lstm)
+                        text_generation_lstm, transformer_lm)
 
 __all__ = [
     "adler32_of", "alexnet", "facenet_nn4_small2", "fetch_cached",
     "googlenet", "inception_resnet_v1", "init_pretrained", "lenet",
-    "resnet50", "simple_cnn", "text_generation_lstm", "vgg16", "vgg19",
+    "resnet50", "simple_cnn", "text_generation_lstm", "transformer_lm",
+    "vgg16", "vgg19",
 ]

@@ -328,3 +328,56 @@ def sample_text(net, *, vocab_size: int, seed_ids, n_steps: int,
         x[0, nxt] = 1.0
         probs = np.asarray(net.rnn_time_step(x))[0]
     return out
+
+
+def transformer_lm(vocab_size: int = 256, *, d_model: int = 256,
+                   n_heads: int = 2, n_blocks: int = 2,
+                   max_length: int = 1024, seed: int = 12345, updater=None,
+                   dtype: str = "float32") -> ComputationGraph:
+    """Decoder-only transformer LM (net-new beyond the reference zoo — its
+    era predates transformers): pre-LN blocks of causal self-attention +
+    gelu MLP with residual adds, LayerNorm head, time-distributed softmax.
+
+    On TPU the attention rides the fused Pallas flash kernels
+    (ops/pallas_attention.py) whenever d_model/n_heads is a multiple of
+    128 and the sequence length tiles by 128; elsewhere it falls back to
+    the XLA path with identical numerics. For sequences beyond one chip,
+    shard the time axis with parallel.ring_attention instead.
+    """
+    from ..nn.layers import (LayerNormalization, PositionalEmbeddingLayer,
+                             SelfAttentionLayer)
+
+    g = (_base_builder(seed, updater or Adam(3e-4), dtype=dtype)
+         .add_inputs("tokens")
+         .add_layer("embed", DenseLayer(n_out=d_model, activation="identity"),
+                    "tokens")
+         .add_layer("pos", PositionalEmbeddingLayer(n_out=d_model,
+                                                    max_length=max_length),
+                    "embed"))
+    h = "pos"
+    for i in range(n_blocks):
+        g = (g
+             .add_layer(f"b{i}_ln1", LayerNormalization(n_out=d_model), h)
+             .add_layer(f"b{i}_attn",
+                        SelfAttentionLayer(n_out=d_model, n_heads=n_heads,
+                                           causal=True), f"b{i}_ln1")
+             .add_vertex(f"b{i}_add1", ElementWiseVertex("add"),
+                         h, f"b{i}_attn")
+             .add_layer(f"b{i}_ln2", LayerNormalization(n_out=d_model),
+                        f"b{i}_add1")
+             .add_layer(f"b{i}_ff1",
+                        DenseLayer(n_out=4 * d_model, activation="gelu"),
+                        f"b{i}_ln2")
+             .add_layer(f"b{i}_ff2",
+                        DenseLayer(n_out=d_model, activation="identity"),
+                        f"b{i}_ff1")
+             .add_vertex(f"b{i}_add2", ElementWiseVertex("add"),
+                         f"b{i}_add1", f"b{i}_ff2"))
+        h = f"b{i}_add2"
+    g = (g.add_layer("ln_f", LayerNormalization(n_out=d_model), h)
+          .add_layer("head", RnnOutputLayer(n_out=vocab_size,
+                                            activation="softmax",
+                                            loss="mcxent"), "ln_f")
+          .set_outputs("head")
+          .set_input_types(InputType.recurrent(vocab_size, max_length)))
+    return ComputationGraph(g.build())

@@ -1,11 +1,13 @@
 from .base import LayerConf
 from .core import (ActivationLayer, AutoEncoder, CenterLossOutputLayer,
                    DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
+                   PositionalEmbeddingLayer,
                    OutputLayer, RnnOutputLayer)
 from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
                    SubsamplingLayer, Subsampling1DLayer, ZeroPadding1DLayer,
                    ZeroPaddingLayer)
-from .norm import BatchNormalization, LocalResponseNormalization
+from .norm import (BatchNormalization, LayerNormalization,
+                   LocalResponseNormalization)
 from .attention import SelfAttentionLayer
 from .recurrent import (GravesBidirectionalLSTM, GravesLSTM, LSTM,
                         LastTimeStepLayer)
@@ -22,9 +24,11 @@ __all__ = [
     "LossFunctionWrapper", "RBM", "VariationalAutoencoder",
     "LayerConf", "ActivationLayer", "AutoEncoder", "CenterLossOutputLayer",
     "DenseLayer", "DropoutLayer", "EmbeddingLayer", "LossLayer", "OutputLayer",
+    "PositionalEmbeddingLayer",
     "RnnOutputLayer", "Convolution1DLayer", "ConvolutionLayer",
     "GlobalPoolingLayer", "SubsamplingLayer", "Subsampling1DLayer",
     "ZeroPadding1DLayer", "ZeroPaddingLayer", "BatchNormalization",
+    "LayerNormalization",
     "LocalResponseNormalization",
     "GravesBidirectionalLSTM", "GravesLSTM", "LSTM", "LastTimeStepLayer",
 ]

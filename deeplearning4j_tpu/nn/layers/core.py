@@ -101,6 +101,39 @@ class EmbeddingLayer(LayerConf):
         return self.act(out), state
 
 
+@register
+@dataclass
+class PositionalEmbeddingLayer(LayerConf):
+    """Learned absolute positional embeddings added to [B,T,F] activations
+    (net-new — required for order-aware attention stacks like
+    models.transformer_lm; the reference's recurrent nets carry position in
+    their state and never needed one). ``max_length`` bounds T; shorter
+    sequences use the table prefix."""
+    n_out: Optional[int] = None        # feature size (inferred)
+    max_length: int = 2048
+
+    param_order: ClassVar[Tuple[str, ...]] = ("P",)
+    weight_param_names: ClassVar[Tuple[str, ...]] = ()   # no decay on positions
+    expected_input: ClassVar[str] = "rnn"
+
+    def output_type(self, itype):
+        return itype
+
+    def init(self, rng, itype, dtype):
+        nf = self.n_out or resolve_ff_size(itype)
+        self.n_out = nf
+        # small-scale normal init (transformer convention)
+        P = 0.02 * jax.random.normal(rng, (self.max_length, nf), dtype)
+        return {"P": P}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        T = x.shape[1]
+        if T > self.max_length:
+            raise ValueError(f"sequence length {T} exceeds max_length "
+                             f"{self.max_length}")
+        return self.act(x + params["P"][:T][None]), state
+
+
 class BaseOutputLayerMixin:
     """Shared loss plumbing for output layers (reference nn/layers/BaseOutputLayer).
 

@@ -89,6 +89,39 @@ class BatchNormalization(LayerConf):
 
 @register
 @dataclass
+class LayerNormalization(LayerConf):
+    """Per-example layer norm over the FEATURE axis (net-new beyond the
+    reference — its era predates transformers; required by the pre-LN
+    transformer blocks in models.transformer_lm). Works on [B,F] and
+    [B,T,F]; gain/bias per feature; no running stats (stateless, unlike
+    BatchNormalization — nothing to desynchronize across a mesh)."""
+    n_out: Optional[int] = None        # feature count (inferred)
+    eps: float = 1e-5
+
+    param_order: ClassVar[Tuple[str, ...]] = ("gain", "bias")
+    weight_param_names: ClassVar[Tuple[str, ...]] = ()
+    expected_input: ClassVar[str] = "any"
+
+    def init(self, rng, itype, dtype):
+        nf = self.n_out or (itype.size if itype is not None else None)
+        if not nf:
+            raise ValueError("LayerNormalization cannot infer its feature "
+                             "count: set n_out or provide an input type")
+        self.n_out = nf
+        return {"gain": jnp.ones((nf,), dtype),
+                "bias": jnp.zeros((nf,), dtype)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.maximum(jnp.mean(x * x, axis=-1, keepdims=True)
+                          - mean * mean, 0.0)
+        inv = lax.rsqrt(var + jnp.asarray(self.eps, x.dtype))
+        y = (x - mean) * inv * params["gain"] + params["bias"]
+        return self.act(y), state
+
+
+@register
+@dataclass
 class LocalResponseNormalization(LayerConf):
     """Cross-channel LRN over NHWC (reference defaults k=2, n=5, alpha=1e-4,
     beta=0.75)."""

@@ -297,8 +297,11 @@ class ChartStackedArea(Component):
 
     def render(self) -> str:
         # a non-finite value in ANY band poisons the whole stacked column
-        # (bands accumulate), so drop those columns entirely
-        cols = [t for t in range(len(self.x))
+        # (bands accumulate), so drop those columns entirely; ragged bands
+        # truncate to the shortest (a mid-update dashboard feed)
+        n = min([len(self.x)] + [len(band) for band in self.y]) \
+            if self.y else 0
+        cols = [t for t in range(n)
                 if _finite(self.x[t]) and all(_finite(band[t])
                                               for band in self.y)]
         if not cols or not self.y:

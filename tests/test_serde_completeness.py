@@ -1,0 +1,80 @@
+"""Every registered config dataclass must JSON round-trip with NON-DEFAULT
+field values — the completeness check that catches a field added to a
+config class but forgotten by serde (reference: the custom deserializers in
+nn/conf/serde/BaseNetConfigDeserializer.java are exercised by every config
+test; here one generative test covers the whole registry)."""
+import dataclasses
+import enum
+import typing
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf import serde
+
+# classes whose constructor args are not independent plain fields (built
+# via their own factories); covered by their dedicated tests instead
+_SKIP = {"MultiLayerConfiguration", "CompositeReconstructionDistribution",
+         "LossFunctionWrapper", "MapSchedule"}
+
+
+def _poke(value, field_name=""):
+    """A deterministic non-default replacement for a field value."""
+    if isinstance(value, bool):
+        return not value
+    if isinstance(value, int):
+        return value + 1
+    if isinstance(value, float):
+        return value + 0.125
+    if isinstance(value, str):
+        # enum-like string fields must keep a valid vocabulary: leave them,
+        # they are exercised by behavior tests; still round-trip as-is
+        return value
+    if isinstance(value, tuple):
+        return tuple(_poke(v) for v in value)
+    if isinstance(value, list):
+        return [_poke(v) for v in value]
+    return value
+
+
+def _instantiate(cls):
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.default is not dataclasses.MISSING:
+            kwargs[f.name] = _poke(f.default, f.name)
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+            kwargs[f.name] = _poke(f.default_factory(), f.name)
+        else:
+            # required field: synthesize by annotation
+            ann = str(f.type)
+            if "int" in ann:
+                kwargs[f.name] = 3
+            elif "float" in ann:
+                kwargs[f.name] = 0.25
+            elif "str" in ann:
+                kwargs[f.name] = "x"
+            elif "Tuple" in ann or "tuple" in ann:
+                kwargs[f.name] = (2, 2)
+            else:
+                kwargs[f.name] = None
+    return cls(**kwargs)
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n, c in serde._REGISTRY.items()
+    if dataclasses.is_dataclass(c) and n not in _SKIP))
+def test_registered_config_round_trips_with_non_defaults(name):
+    cls = serde._REGISTRY[name]
+    obj = _instantiate(cls)
+    payload = serde.to_json(obj)
+    back = serde.from_json(payload)
+    assert type(back) is cls
+    for f in dataclasses.fields(cls):
+        if f.metadata.get("skip_serde", False):
+            continue
+        a, b = getattr(obj, f.name), getattr(back, f.name)
+        # tuples may deserialize as lists — compare by content
+        if isinstance(a, tuple):
+            a = list(a)
+        if isinstance(b, tuple):
+            b = list(b)
+        assert a == b, (name, f.name, a, b)

@@ -123,3 +123,11 @@ def test_non_finite_filtering_stacked_area_and_histogram():
     svg = h.render()
     assert "nan" not in svg
     assert svg.count("<rect") >= 2   # the two finite bins still draw
+
+
+def test_stacked_area_ragged_bands_truncate():
+    """Ragged band lengths (a mid-update dashboard feed) truncate to the
+    shortest instead of crashing."""
+    sa = C.ChartStackedArea(x=[0, 1, 2], y=[[1.0, 2.0]], series_names=["a"])
+    svg = sa.render()
+    assert "polygon" in svg

@@ -175,12 +175,17 @@ class ShardedFileDataSetIterator(DataSetIterator):
             return out
         return None
 
+    def _open_npz(self, path: str):
+        """Shard-file opener hook (np.load here; the native subclass
+        serves the same protocol from the C++ mmap reader)."""
+        return np.load(path)
+
     def __iter__(self) -> Iterator[DataSet]:
         order = list(self._files)
         if self.shuffle_shards:
             self._rng.shuffle(order)
         for fname in order:
-            with np.load(os.path.join(self.data_dir, fname)) as z:
+            with self._open_npz(os.path.join(self.data_dir, fname)) as z:
                 n = 0
                 while (f"features_{n}" in z.files
                        or f"features_{n}_len" in z.files
@@ -196,3 +201,30 @@ class ShardedFileDataSetIterator(DataSetIterator):
 
     def reset(self):
         pass
+
+
+class NativeShardedFileDataSetIterator(ShardedFileDataSetIterator):
+    """ShardedFileDataSetIterator served by the C++ mmap shard reader
+    (native/shard_reader.cpp): zip/npy headers parse natively and member
+    payloads arrive via one GIL-free memcpy — the data-plane stays native
+    like the reference's DataVec/ND4J loaders (SURVEY.md §3 L3). Falls
+    back to numpy parsing per file if the native parse rejects it."""
+
+    def _open_npz(self, path: str):
+        from ..native import NativeNpzFile, shard_reader_available
+        if shard_reader_available():
+            try:
+                return NativeNpzFile(path)
+            except OSError:
+                pass                      # e.g. a compressed npz: numpy path
+        return np.load(path)
+
+
+def make_shard_iterator(data_dir: str, *, prefer_native: bool = True,
+                        **kw) -> ShardedFileDataSetIterator:
+    """The production entry point: native reader when the toolchain built
+    it, numpy otherwise — same iterator contract either way."""
+    from ..native import shard_reader_available
+    if prefer_native and shard_reader_available():
+        return NativeShardedFileDataSetIterator(data_dir, **kw)
+    return ShardedFileDataSetIterator(data_dir, **kw)

@@ -26,11 +26,14 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_SR_LIB: Optional[ctypes.CDLL] = None
+_SR_TRIED = False
 
 
-def _build_and_load() -> Optional[ctypes.CDLL]:
-    src = os.path.join(_HERE, "threshold_codec.cpp")
-    out = os.path.join(_BUILD_DIR, "libthreshold_codec.so")
+def _compile(src_name: str, lib_name: str) -> Optional[ctypes.CDLL]:
+    """g++ -O3 -shared -fPIC on demand; None when no toolchain."""
+    src = os.path.join(_HERE, src_name)
+    out = os.path.join(_BUILD_DIR, lib_name)
     if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
         os.makedirs(_BUILD_DIR, exist_ok=True)
         cmd = ["g++", "-O3", "-shared", "-fPIC", src, "-o", out]
@@ -39,8 +42,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         except (OSError, subprocess.SubprocessError):
             return None
     try:
-        lib = ctypes.CDLL(out)
+        return ctypes.CDLL(out)
     except OSError:
+        return None
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    lib = _compile("threshold_codec.cpp", "libthreshold_codec.so")
+    if lib is None:
         return None
     lib.threshold_encode.restype = ctypes.c_int
     lib.threshold_encode.argtypes = [
@@ -106,3 +115,102 @@ def native_threshold_decode(idx: np.ndarray, signs: np.ndarray,
         idx.shape[0], ctypes.c_float(threshold),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
     return out
+
+
+# ------------------------------------------------------------ shard reader
+def _sr_build_and_load() -> Optional[ctypes.CDLL]:
+    lib = _compile("shard_reader.cpp", "libshard_reader.so")
+    if lib is None:
+        return None
+    c = ctypes
+    lib.sr_open.restype = c.c_void_p
+    lib.sr_open.argtypes = [c.c_char_p]
+    lib.sr_num_members.restype = c.c_int
+    lib.sr_num_members.argtypes = [c.c_void_p]
+    lib.sr_member_name.restype = c.c_char_p
+    lib.sr_member_name.argtypes = [c.c_void_p, c.c_int]
+    lib.sr_member_descr.restype = c.c_char_p
+    lib.sr_member_descr.argtypes = [c.c_void_p, c.c_int]
+    lib.sr_member_ndim.restype = c.c_int
+    lib.sr_member_ndim.argtypes = [c.c_void_p, c.c_int]
+    lib.sr_member_shape.restype = None
+    lib.sr_member_shape.argtypes = [c.c_void_p, c.c_int,
+                                    c.POINTER(c.c_int64)]
+    lib.sr_member_fortran.restype = c.c_int
+    lib.sr_member_fortran.argtypes = [c.c_void_p, c.c_int]
+    lib.sr_member_nbytes.restype = c.c_int64
+    lib.sr_member_nbytes.argtypes = [c.c_void_p, c.c_int]
+    lib.sr_read.restype = c.c_int
+    lib.sr_read.argtypes = [c.c_void_p, c.c_int, c.c_void_p]
+    lib.sr_close.restype = None
+    lib.sr_close.argtypes = [c.c_void_p]
+    return lib
+
+
+def _sr_lib() -> Optional[ctypes.CDLL]:
+    global _SR_LIB, _SR_TRIED
+    with _LOCK:
+        if not _SR_TRIED:
+            _SR_LIB = _sr_build_and_load()
+            _SR_TRIED = True
+    return _SR_LIB
+
+
+def shard_reader_available() -> bool:
+    """True when the native shard reader compiled and loaded on this host."""
+    return _sr_lib() is not None
+
+
+class NativeNpzFile:
+    """np.load-compatible view of an uncompressed .npz, served by the C++
+    mmap reader (datasets/export.py's shard format): exposes ``.files`` and
+    ``__getitem__`` like numpy's NpzFile, but the zip/npy headers are
+    parsed natively and member payloads arrive via a single GIL-free
+    memcpy. Context-manage or .close() to drop the mmap."""
+
+    def __init__(self, path: str):
+        lib = _sr_lib()
+        if lib is None:
+            raise RuntimeError("native shard reader unavailable (no g++?); "
+                               "use numpy.load instead")
+        self._lib = lib
+        self._h = lib.sr_open(os.fsencode(path))
+        if not self._h:
+            raise OSError(f"native shard reader could not parse {path!r} "
+                          "(not an uncompressed npz?)")
+        n = lib.sr_num_members(self._h)
+        self.files = [lib.sr_member_name(self._h, i).decode()
+                      for i in range(n)]
+        self._index = {name: i for i, name in enumerate(self.files)}
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        i = self._index[name]
+        lib = self._lib
+        ndim = lib.sr_member_ndim(self._h, i)
+        shape = (ctypes.c_int64 * max(ndim, 1))()
+        if ndim:
+            lib.sr_member_shape(self._h, i, shape)
+        descr = lib.sr_member_descr(self._h, i).decode()
+        order = "F" if lib.sr_member_fortran(self._h, i) else "C"
+        out = np.empty(tuple(shape[:ndim]), dtype=np.dtype(descr),
+                       order=order)
+        assert out.nbytes == lib.sr_member_nbytes(self._h, i)
+        lib.sr_read(self._h, i, out.ctypes.data_as(ctypes.c_void_p))
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.sr_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -68,11 +68,13 @@ class PerformanceListener(TrainingListener):
         self._last_time = None
         self._samples = 0
         self._batches = 0
+        self._etl_ms = 0.0
         self.history: List[dict] = []
 
-    def note_batch(self, n_samples: int):
+    def note_batch(self, n_samples: int, etl_ms: float = 0.0):
         self._samples += n_samples
         self._batches += 1
+        self._etl_ms += etl_ms
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
@@ -84,13 +86,18 @@ class PerformanceListener(TrainingListener):
             rec = {"iteration": iteration,
                    "samples_per_sec": self._samples / dt,
                    "batches_per_sec": self._batches / dt,
+                   "etl_ms_per_iteration": self._etl_ms / self._batches,
                    "score": float(score)}
             self.history.append(rec)
-            log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, score=%.5f",
-                     iteration, rec["samples_per_sec"], rec["batches_per_sec"], score)
+            log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, "
+                     "etl %.2f ms/it, score=%.5f",
+                     iteration, rec["samples_per_sec"],
+                     rec["batches_per_sec"], rec["etl_ms_per_iteration"],
+                     score)
             self._last_time = now
             self._samples = 0
             self._batches = 0
+            self._etl_ms = 0.0
 
 
 class TimeIterationListener(TrainingListener):

@@ -12,6 +12,7 @@ recompiles.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import jax
@@ -168,7 +169,13 @@ class Solver:
             for l in net.listeners:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_start(net)
+            # ETL timing: the gap between iterations spent FETCHING +
+            # host-preparing the batch (reference lastEtlTime, set in the
+            # fit loop MultiLayerNetwork.java:1130 and reported by
+            # PerformanceListener.java:111,178)
+            _etl_t0 = time.perf_counter()
             for ds in it_wrapped:
+                etl_ms = (time.perf_counter() - _etl_t0) * 1e3
                 x = _cast_any(ds.features, dtype)
                 y = _cast_any(ds.labels, dtype)
                 lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)
@@ -193,11 +200,12 @@ class Solver:
                 # listeners get the index of the last executed iteration
                 it_idx = net.iteration_count - 1 if tbptt else net.iteration_count
                 for p in perf:
-                    p.note_batch(ds.num_examples())
+                    p.note_batch(ds.num_examples(), etl_ms=etl_ms)
                 for l in net.listeners:
                     l.iteration_done(net, it_idx, loss)
                 if not tbptt:
                     net.iteration_count += 1
+                _etl_t0 = time.perf_counter()
             for l in net.listeners:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_end(net)

@@ -1,0 +1,101 @@
+"""C++ mmap shard reader (native/shard_reader.cpp): exact parity with
+numpy's npz parsing on the export-shard format, through both the raw
+NativeNpzFile protocol and the iterator seam."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.export import (
+    NativeShardedFileDataSetIterator, ShardedFileDataSetIterator,
+    export_dataset_iterator, make_shard_iterator)
+from deeplearning4j_tpu.native import NativeNpzFile, shard_reader_available
+
+pytestmark = pytest.mark.skipif(not shard_reader_available(),
+                                reason="no g++ toolchain on this host")
+
+R = np.random.default_rng(3)
+
+
+def _export(tmp_path, n_batches=5):
+    def gen():
+        for i in range(n_batches):
+            x = R.normal(size=(8, 6, 4)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[R.integers(0, 3, 8)]
+            m = (R.random((8, 6)) > 0.3).astype(np.float32)
+            yield DataSet(x, y, m, None)
+    export_dataset_iterator(gen(), str(tmp_path), batches_per_shard=2)
+
+
+def test_native_npz_member_parity(tmp_path):
+    """Every member of every shard: same names, dtypes, shapes, bytes."""
+    _export(tmp_path)
+    import glob
+    import os
+    for path in sorted(glob.glob(os.path.join(str(tmp_path), "*.npz"))):
+        with np.load(path) as z_np, NativeNpzFile(path) as z_nat:
+            assert sorted(z_nat.files) == sorted(z_np.files)
+            for name in z_np.files:
+                a, b = z_np[name], z_nat[name]
+                assert a.dtype == b.dtype, name
+                assert a.shape == b.shape, name
+                np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_native_iterator_matches_python_iterator(tmp_path):
+    _export(tmp_path)
+    py_batches = list(ShardedFileDataSetIterator(str(tmp_path)))
+    nat_batches = list(NativeShardedFileDataSetIterator(str(tmp_path)))
+    assert len(py_batches) == len(nat_batches) == 5
+    for a, b in zip(py_batches, nat_batches):
+        np.testing.assert_array_equal(a.features, b.features)
+        np.testing.assert_array_equal(a.labels, b.labels)
+        np.testing.assert_array_equal(a.features_mask, b.features_mask)
+        assert b.labels_mask is None
+
+
+def test_make_shard_iterator_prefers_native(tmp_path):
+    _export(tmp_path, n_batches=2)
+    it = make_shard_iterator(str(tmp_path))
+    assert isinstance(it, NativeShardedFileDataSetIterator)
+    it2 = make_shard_iterator(str(tmp_path), prefer_native=False)
+    assert type(it2) is ShardedFileDataSetIterator
+    assert len(list(it)) == len(list(it2)) == 2
+
+
+def test_dtype_zoo_round_trip(tmp_path):
+    """uint8/int32/int64/f32/f64/bf16-as-void members all parse."""
+    import jax.numpy as jnp
+    path = str(tmp_path / "mixed.npz")
+    arrs = {
+        "u8": R.integers(0, 255, (4, 5)).astype(np.uint8),
+        "i32": R.integers(-9, 9, (7,)).astype(np.int32),
+        "i64": R.integers(-9, 9, (2, 2, 2)).astype(np.int64),
+        "f32": R.normal(size=(3, 3)).astype(np.float32),
+        "f64": R.normal(size=(6,)),
+        "bf16": np.asarray(jnp.asarray([1.5, -2.25], jnp.bfloat16)),
+        "scalar": np.asarray(3.25, np.float32),
+    }
+    np.savez(path, **arrs)
+    with NativeNpzFile(path) as z:
+        for name, a in arrs.items():
+            b = z[name]
+            assert b.dtype == a.dtype and b.shape == a.shape, name
+            np.testing.assert_array_equal(a.view(np.uint8) if a.dtype.kind == "V"
+                                          else a,
+                                          b.view(np.uint8) if b.dtype.kind == "V"
+                                          else b, err_msg=name)
+
+
+def test_compressed_npz_falls_back(tmp_path):
+    """A COMPRESSED npz (np.savez_compressed) is rejected by the native
+    parser and served by numpy through the iterator's fallback."""
+    path = str(tmp_path / "c.npz")
+    np.savez_compressed(path, x=np.arange(10.0))
+    with pytest.raises(OSError):
+        NativeNpzFile(path)
+    # the iterator seam still reads it
+    export_dataset_iterator(iter([DataSet(
+        np.zeros((2, 2), np.float32), np.zeros((2, 2), np.float32))]),
+        str(tmp_path / "shards"))
+    it = NativeShardedFileDataSetIterator(str(tmp_path / "shards"))
+    assert len(list(it)) == 1

@@ -134,3 +134,45 @@ def test_masked_training():
     net = MultiLayerNetwork(conf).init()
     net.fit(iterator=ListDataSetIterator([ds]), epochs=2)
     assert np.all(np.isfinite(np.asarray(net.params_flat())))
+
+
+def test_performance_listener_reports_etl_time():
+    """ETL (batch fetch + host prep) time is measured per iteration and
+    reported by PerformanceListener (reference PerformanceListener.java:
+    111,178 fed from the fit loop's lastEtlTime)."""
+    import time as _time
+
+    import numpy as np
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    r = np.random.default_rng(0)
+
+    class SlowIterator:
+        """Iterator whose next() takes measurable host time."""
+        def __iter__(self):
+            for _ in range(4):
+                _time.sleep(0.02)
+                x = r.normal(size=(16, 4)).astype(np.float32)
+                y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+                yield DataSet(x, y)
+
+        def reset(self):
+            pass
+
+    conf = (NeuralNetConfiguration(seed=1, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    perf = PerformanceListener(frequency=1)
+    net.set_listeners(perf)
+    net.fit(iterator=SlowIterator(), epochs=1)
+    assert perf.history, "no performance records"
+    etl = [rec["etl_ms_per_iteration"] for rec in perf.history]
+    # the 20ms sleep in the iterator must show up as ETL time
+    assert max(etl) >= 10.0, etl

@@ -5,25 +5,33 @@ ResNet-50 ImageNet-shape training throughput, img/sec/chip, f32 224x224
 (BASELINE #2), vs an independent flax.linen+optax ResNet-50 on the same
 device/batch/dtype — target >= 0.70x (vs_baseline = ours/reference).
 
-Measurement integrity contract (round-4; BENCH_r03 shipped an AMP row at
-937% MFU — the tunnel's lazy-completion artifact — so every number is now
-checked in code, not prose):
-  1. Every throughput row with a known per-step FLOP count is checked
-     against the MXU roofline: implied MFU must be <= BENCH_MAX_PLAUSIBLE_MFU
-     (default 0.60 — our best honest row is ~0.30).
-  2. A chained-timing row that violates the roofline is RE-MEASURED with the
-     device-slope method (n steps inside one jitted fori_loop, two n values
-     differenced — immune to per-call transport artifacts).
-  3. If the re-measure still violates the roofline, the row is published as
-     {"value": null, "estimate": <roofline upper bound>, "invalid_reason": ...}
-     — an impossible number is never printed as a value.
-  4. Sub-ms measured times are cross-checked against the HBM floor
+Measurement integrity contract (round-5; BENCH_r03 shipped an AMP row at
+937% MFU — the tunnel's lazy-completion artifact — and BENCH_r04 timed out
+re-measuring every row, so both the numbers AND the artifact pipeline are
+now defended in code, not prose):
+  1. Every device-rate row is SLOPE-timed from the start (n steps inside
+     one jitted fori_loop with a TRACED trip count, two n values
+     differenced, readback-barriered — immune to per-call transport
+     artifacts; one compile per row). r4 proved chained timing always
+     fails its readback validation on this rig, so the chained phase is
+     gone.
+  2. Every row with a known per-step FLOP count is checked against the
+     MXU roofline: implied MFU must be <= BENCH_MAX_PLAUSIBLE_MFU
+     (default 0.60 — our best honest row is ~0.33). A row that violates
+     it is published as {"value": null, "estimate": <roofline bound>,
+     "invalid_reason": ...} — an impossible number is never printed.
+  3. Sub-ms measured times are cross-checked against the HBM floor
      (bytes_accessed / BENCH_HBM_GBPS); a "measurement" faster than memory
      allows is replaced by the bandwidth-bound estimate, labeled as such.
-  5. _loop_slope_time asserts a positive slope (transport jitter can make
+  4. _slope_measure asserts a positive slope (transport jitter can make
      the larger-n window time faster); it retries with more differenced
-     work and raises BenchImplausible rather than returning a negative or
-     infinite throughput.
+     work (same compiled program) and raises BenchImplausible rather than
+     returning a negative or infinite throughput.
+  5. Artifact survival: the FULL result JSON is re-printed after every
+     row (latest-line-wins), a SIGTERM/SIGINT handler and an atexit hook
+     flush the rows done so far, the wall-clock budget covers warmup +
+     core rows + extras, and each row runs under a SIGALRM cap so one
+     pathological row cannot starve the rest.
 
 The same line carries an ``extras`` dict with the remaining BASELINE rows:
   - resnet50_bf16_img_per_sec      ResNet-50, bfloat16 params+data, batch>=128
@@ -45,7 +53,7 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    rides the fused Pallas cell
   - lstm_reference_tokens_per_sec  independent flax OptimizedLSTMCell char-RNN
   - lstm_vs_reference              plain / reference (apples-to-apples ratio)
-    All three LSTM rows use DEVICE-slope timing (_loop_slope_time): the
+    All three LSTM rows use DEVICE-slope timing (_slope_measure): the
     ~ms-scale per-call tunnel dispatch floor would otherwise swamp the
     ~0.2ms step and compress any real ratio toward 1.0.
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
@@ -57,6 +65,13 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    fused Pallas flash kernels vs the XLA
                                    path (ops/pallas_attention.py), both
                                    slope-timed, + fused_vs_xla ratio
+  - transformer_lm_tokens_per_sec  end-to-end decoder-only LM train step
+                                   (12 blocks, d=512, 8 heads -> head dim
+                                   64 on the fused flash path, T=1024,
+                                   bf16, token-id input) vs an independent
+                                   flax implementation of the same arch
+                                   (transformer_lm_flax_tokens_per_sec,
+                                   stock XLA attention) + vs_flax ratio
   - collective_overhead_by_mesh    per-step overhead of psum sync-DP on 1/2/
                                    4/8-device virtual CPU meshes (BASELINE #5;
                                    chips unavailable, so this measures mesh +
@@ -71,13 +86,17 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    25M-param flat gradient (DCN codec cost)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
-BENCH_BUDGET_S, BENCH_PEAK_TFLOPS, BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU,
-BENCH_REPEATS (timed windows per bench, best-of; default 3).
+BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1500),
+BENCH_ROW_CAP_S (per-row SIGALRM cap; default 300), BENCH_PEAK_TFLOPS,
+BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU, BENCH_REPEATS (timed windows per
+bench, best-of; default 3).
 """
+import atexit
 import functools
 import json
 import math
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -103,6 +122,16 @@ MAX_PLAUSIBLE_MFU = float(os.environ.get("BENCH_MAX_PLAUSIBLE_MFU", "0.6"))
 
 class BenchImplausible(RuntimeError):
     """A timing that no physically possible execution could produce."""
+
+
+def _cost_analysis(compiled) -> dict:
+    """Normalize compiled.cost_analysis() across backends (list-of-dict on
+    some, dict on others, occasionally neither) — the ONE place that knows
+    the quirk."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if hasattr(ca, "get") else {}
 
 
 def _implied_mfu(flops_per_step, dt):
@@ -141,69 +170,102 @@ def _readback_barrier(tree):
     return total
 
 
-def _loop_slope_time(step_fn, args, n_pair=(64, 576)):
-    """True DEVICE time per training step, measured as the slope between two
-    fori_loop repetition counts inside single jitted calls.
+def _slope_measure(step_fn, args, n_pair=None):
+    """True DEVICE time per training step, measured as the slope between
+    two fori_loop repetition counts. Returns (dt_per_step, flops_per_step).
 
     Rationale: the axon chip sits behind a tunnel with ~100ms synchronous
-    round-trip and a multi-ms pipelined dispatch floor per distinct call —
-    host-chained step timing therefore reports the transport, not the chip,
-    for any step under a few ms (the LSTM char-RNN step is ~0.2-0.3ms of
-    real device work). Running n steps inside ONE call and differencing two
-    n values cancels every fixed per-call cost. Each timing call is salted
-    (a real input folded in at 1e-30 scale) so the transport cannot serve a
-    cached result for a repeated identical request. The n values are large
-    enough that the differenced device work (hundreds of ms) dominates the
-    tunnel's multi-ms call-time jitter.
+    round-trip, a multi-ms pipelined dispatch floor per distinct call, AND
+    a lazy-completion artifact (``block_until_ready`` can return before the
+    device finishes — BENCH_r04 showed EVERY chained-timing row failing its
+    readback validation). Host-chained step timing therefore reports the
+    transport, not the chip; this bench goes STRAIGHT to the slope method
+    for every device-rate row. Running n steps inside ONE call and
+    differencing two n values cancels every fixed per-call cost. Each
+    timing call is salted (a real input folded in at 1e-30 scale) so the
+    transport cannot serve a cached result for a repeated identical
+    request.
+
+    One compile per row (the r4 run burned 250-550s/row on doubled
+    compiles): the trip count ``n`` is a TRACED argument, so a single
+    compiled while-loop program serves both n values and any retry. The
+    same program's cost analysis supplies the per-step flop count — XLA
+    counts a while body once (verified <=0.1% off the single-step
+    analysis on this stack), so no separate AOT step compile is needed.
 
     Completion barrier: each timed call returns a SCALAR checksum of the
     final loop state and the timer stops at the checksum's host readback
-    (np.asarray). On this rig ``block_until_ready`` returns before the
-    device finishes (observed: a warm fori_loop(8) of ~10ms attention
-    steps "completed" in 0.17s while the value readback took 1.9s more),
-    so readback is the only trustworthy barrier; its ~100ms RTT is a
-    per-call CONSTANT that the slope cancels.
+    (np.asarray) — the only barrier this transport honors; its ~100ms RTT
+    is a per-call CONSTANT that the slope cancels.
 
-    Raises BenchImplausible if the slope is non-positive after a retry with
-    4x the differenced work (transport jitter can make the larger-n window
-    time faster; silently returning a negative per-step time would surface
-    as negative/infinite throughput in a headline row).
+    Raises BenchImplausible if the slope is non-positive after a retry
+    with 4x the differenced work (transport jitter can make the larger-n
+    window time faster; silently returning a negative per-step time would
+    surface as negative/infinite throughput in a headline row).
     """
     import jax
     import jax.numpy as jnp
 
     x, state = args
 
-    def make(n):
-        @jax.jit
-        def many(salt, x, st):
-            xs = x + jnp.asarray(salt, x.dtype) * 1e-30
-            out = jax.lax.fori_loop(0, n, lambda k, a: step_fn(xs, a), st)
-            # scalar checksum touching EVERY output leaf: fetching it
-            # forces the whole loop to have actually executed
-            leaves = [jnp.ravel(l)[0].astype(jnp.float32)
-                      for l in jax.tree.leaves(out)]
-            return functools.reduce(jnp.add, leaves)
-        return many
+    def body(n, salt, x, st):
+        # fold the salt WITHOUT changing x's dtype: int inputs (token ids)
+        # must stay ints (1e-30 rounds to 0 in the cast, but salt is still
+        # a per-call-distinct input buffer, which is what defeats the
+        # transport's identical-request cache)
+        xs = x + (jnp.asarray(salt, jnp.float32) * 1e-30).astype(x.dtype)
+        out = jax.lax.fori_loop(0, n, lambda k, a: step_fn(xs, a), st)
+        # scalar checksum touching EVERY output leaf: fetching it forces
+        # the whole loop to have actually executed
+        leaves = [jnp.ravel(l)[0].astype(jnp.float32)
+                  for l in jax.tree.leaves(out)]
+        return functools.reduce(jnp.add, leaves)
 
+    jitted = jax.jit(body)
+    flops = None
+    try:
+        compiled = jitted.lower(np.int32(2), 0.0, x, state).compile()
+        f = _cost_analysis(compiled).get("flops")
+        if f:
+            flops = float(f)
+
+        def runner(n, s):
+            return compiled(np.int32(n), np.float32(s), x, state)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"[bench] loop AOT/cost-analysis unavailable ({e}); "
+              f"timing via jit", file=sys.stderr)
+
+        def runner(n, s):
+            return jitted(np.int32(n), np.float32(s), x, state)
+
+    if n_pair is None:
+        # size the pair from the roofline floor so the differenced work is
+        # >= ~1s even at the fastest plausible speed (at a real 15-33% MFU
+        # it lands at 2-8s — big enough to dominate multi-ms call jitter)
+        if flops:
+            n0 = max(2, min(64, math.ceil(0.5 / _roofline_dt(flops))))
+            n_pair = (n0, 3 * n0)
+        else:
+            n_pair = (64, 576)
+
+    np.asarray(runner(n_pair[0], 0.0))       # warm: first execution
     salt = 0.0
     for attempt in range(2):
         times = []
         for n in n_pair:
-            f = make(n)
-            np.asarray(f(0.0, x, state))     # warm: compile + execute
             best = float("inf")
             for _ in range(REPEATS):
                 salt += 1.0
                 t0 = time.perf_counter()
-                np.asarray(f(salt, x, state))
+                np.asarray(runner(n, salt))
                 best = min(best, time.perf_counter() - t0)
             times.append(best)
         slope = (times[1] - times[0]) / (n_pair[1] - n_pair[0])
         if slope > 0:
-            return slope
+            return slope, flops
         print(f"[bench] non-positive slope {slope:.3g} at n_pair={n_pair}; "
-              f"retrying with 4x work", file=sys.stderr)
+              f"retrying with 4x work (same compiled program)",
+              file=sys.stderr)
         n_pair = (n_pair[0] * 4, n_pair[1] * 4)
     raise BenchImplausible(
         f"non-positive device-time slope after retry (times={times}, "
@@ -237,10 +299,7 @@ def _aot(jitted, args):
     avoids a second trace/compile through jit's own cache."""
     try:
         compiled = jitted.lower(*args).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = ca.get("flops") if hasattr(ca, "get") else None
+        flops = _cost_analysis(compiled).get("flops")
         return compiled, (float(flops) if flops else None)
     except Exception as e:  # pragma: no cover - backend-dependent
         print(f"AOT cost analysis unavailable ({e}); timing via jit",
@@ -248,102 +307,36 @@ def _aot(jitted, args):
         return jitted, None
 
 
-def _guarded_rate(step_xc, x, carry, *, items_per_step, label, steps=STEPS):
-    """Measure items/sec for a (x, carry)->carry training step with the
-    roofline self-check. Chained timing first (cheap, correct for >=50ms
-    steps); on a roofline violation re-measure with the device-slope
-    method; if STILL impossible, return the null row.
+def _slope_rate(step_xc, x, carry, *, items_per_step, label, flops=None,
+                n_pair=None):
+    """items/sec for a (x, carry)->carry training step: slope-timed (the
+    only method the tunnel can't corrupt — see _slope_measure) with the
+    roofline self-check.
+
+    ``flops``: caller-supplied ANALYTIC per-step flop count; overrides the
+    loop program's cost analysis (mandatory for Pallas rows — XLA cannot
+    see inside custom calls, and an under-counted denominator would only
+    loosen the guard).
 
     Returns (row, dt, flops): row is a float (valid) or the invalid-row
     dict; dt/flops feed the MFU table (dt None when the row is invalid).
     """
-    import jax
-
-    jitted = jax.jit(step_xc, donate_argnums=(1,))
-    runner, flops = _aot(jitted, [x, carry])
-
-    state = carry
-    for _ in range(WARMUP):
-        state = runner(x, state)
-    jax.block_until_ready(state)
-    best = float("inf")
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state = runner(x, state)
-        jax.block_until_ready(state)
-        best = min(best, time.perf_counter() - t0)
-    dt = best / steps
-
-    # lazy-completion detector: one more window whose barrier is a VALUE
-    # readback (block_until_ready can return before the device finishes on
-    # this rig). The readback's ~0.1-0.2s RTT rides on a multi-second
-    # window, so a big mismatch means the timed windows were lies.
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state = runner(x, state)
-    _readback_barrier(state)
-    wall = time.perf_counter() - t0
-    lied = wall > 1.5 * (dt * steps) + 0.5
-
-    mfu = _implied_mfu(flops, dt)
-    if not lied and (mfu is None or mfu <= MAX_PLAUSIBLE_MFU):
-        return items_per_step / dt, dt, flops
-
-    # Chained timing produced a physically impossible number (the tunnel's
-    # lazy-completion artifact) — re-measure with the slope method, sizing
-    # n so the differenced work is >= ~2s at the fastest plausible speed.
-    reason = (f"implies {mfu:.1%} MFU" if (mfu or 0) > MAX_PLAUSIBLE_MFU
-              else f"readback window took {wall:.2f}s vs timed "
-                   f"{dt * steps:.2f}s")
-    print(f"[bench] {label}: chained timing {reason} — re-measuring via "
-          f"device slope", file=sys.stderr)
-    if flops is None:
-        # no roofline available either: publish the slope result with the
-        # readback barrier (it is the trustworthy method), unguarded
-        try:
-            dt = _loop_slope_time(step_xc, (x, state))
-        except BenchImplausible as e:
-            return _invalid_row(items_per_step, None, str(e)), None, None
-        return items_per_step / dt, dt, flops
-    dt_floor = _roofline_dt(flops)
-    n0 = max(2, min(64, math.ceil(1.0 / dt_floor)))
     try:
-        dt = _loop_slope_time(step_xc, (x, state), n_pair=(n0, 3 * n0))
+        dt, ca_flops = _slope_measure(step_xc, (x, carry), n_pair=n_pair)
     except BenchImplausible as e:
         return _invalid_row(items_per_step, flops, str(e)), None, flops
-    mfu = _implied_mfu(flops, dt)
-    if mfu is not None and mfu > MAX_PLAUSIBLE_MFU:
-        return (_invalid_row(
-            items_per_step, flops,
-            f"slope re-measure still implies {mfu:.1%} MFU "
-            f"(> {MAX_PLAUSIBLE_MFU:.0%} plausibility ceiling)"),
-            None, flops)
-    print(f"[bench] {label}: slope re-measure OK ({mfu:.1%} MFU)",
-          file=sys.stderr)
-    # publish the method so mixed-method ratios are readable in the
-    # artifact (chained rows that PASS the readback validation stay floats)
-    return {"value": round(items_per_step / dt, 3),
-            "method": "device_slope_readback",
-            "note": "chained window failed readback validation; "
-                    "re-measured"}, dt, flops
-
-
-def _slope_rate_guarded(step_xc, x, carry, *, items_per_step, flops, label,
-                        n_pair=(64, 576)):
-    """Slope-timed rate with the same roofline contract (for sub-ms steps
-    where chained timing is transport-dominated from the start)."""
-    try:
-        dt = _loop_slope_time(step_xc, (x, carry), n_pair=n_pair)
-    except BenchImplausible as e:
-        return _invalid_row(items_per_step, flops, str(e)), None
+    flops = flops if flops is not None else ca_flops
     mfu = _implied_mfu(flops, dt)
     if mfu is not None and mfu > MAX_PLAUSIBLE_MFU:
         return (_invalid_row(
             items_per_step, flops,
             f"device-slope timing implies {mfu:.1%} MFU "
-            f"(> {MAX_PLAUSIBLE_MFU:.0%} plausibility ceiling)"), None)
-    return items_per_step / dt, dt
+            f"(> {MAX_PLAUSIBLE_MFU:.0%} plausibility ceiling)"),
+            None, flops)
+    if mfu is not None:
+        print(f"[bench] {label}: {mfu:.1%} MFU (device slope)",
+              file=sys.stderr)
+    return items_per_step / dt, dt, flops
 
 
 def _rowval(row):
@@ -380,8 +373,8 @@ def bench_ours(dtype="float32", batch=None, img=None, compute_dtype=None,
 
     carry = (net.params, net.state, net.opt_state,
              jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
-    row, dt, flops = _guarded_rate(step, x, carry, items_per_step=batch,
-                                   label=label)
+    row, dt, flops = _slope_rate(step, x, carry, items_per_step=batch,
+                                 label=label)
     return row, dt, flops
 
 
@@ -461,8 +454,8 @@ def bench_reference(dtype="float32", batch=None):
         return optax.apply_updates(params, updates), new_bs, new_opt
 
     carry = (params, batch_stats, opt_state)
-    row, dt, flops = _guarded_rate(step, x, carry, items_per_step=batch,
-                                   label=f"resnet50_flax_{dtype}")
+    row, dt, flops = _slope_rate(step, x, carry, items_per_step=batch,
+                                 label=f"resnet50_flax_{dtype}")
     return row, dt, flops
 
 
@@ -510,20 +503,18 @@ def bench_piped(batch=128):
         new_params, new_opt = net.updater.update(grads, opt_state, params, it)
         return new_params, new_state, new_opt, it + 1, key
 
-    # flop count for the roofline check (lowered BEFORE timing: the timed
-    # loop donates the param buffers)
-    try:
-        x0 = jnp.zeros((batch, img, img, 3), jnp.uint8)
-        y0 = jnp.zeros((batch,), jnp.int32)
-        _, flops = _aot(step, [net.params, net.state, net.opt_state,
-                               jnp.asarray(0, jnp.int32),
-                               jax.random.PRNGKey(0), x0, y0])
-    except Exception:
-        flops = None
+    # one AOT compile serves both the roofline flop count AND the epoch
+    # runs (lowered BEFORE timing: the timed loop donates the param
+    # buffers; going through jit afterwards would compile a second time)
+    x0 = jnp.zeros((batch, img, img, 3), jnp.uint8)
+    y0 = jnp.zeros((batch,), jnp.int32)
+    runner, flops = _aot(step, [net.params, net.state, net.opt_state,
+                                jnp.asarray(0, jnp.int32),
+                                jax.random.PRNGKey(0), x0, y0])
 
     # measured host->device bandwidth (for gap attribution); the buffer is
     # salted per call — the tunnel serves repeated IDENTICAL requests from
-    # a cache (see _loop_slope_time), which would fake the bandwidth
+    # a cache (see _slope_measure), which would fake the bandwidth
     buf = np.zeros((batch, img, img, 3), np.uint8)
     jax.block_until_ready(jax.device_put(buf))
     bw_best = float("inf")
@@ -553,7 +544,7 @@ def bench_piped(batch=128):
             for ds in it:
                 x = jnp.asarray(ds.features)
                 y = jnp.asarray(ds.labels)
-                carry = list(step(*carry, x, y))
+                carry = list(runner(*carry, x, y))
                 n += 1
             # value readback: the completion barrier this tunnel honors
             # (block_until_ready can return early; cost: one RTT per epoch)
@@ -617,11 +608,11 @@ def bench_lstm(cell: str = "graves"):
 
     carry = (net.params, net.state, net.opt_state,
              jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
-    _, flops = _aot(jax.jit(step), [x, carry])
     # device-slope timing: the LSTM step is ~0.2ms of device work, far below
-    # the tunnel's per-call dispatch floor — see _loop_slope_time
-    row, dt = _slope_rate_guarded(step, x, carry, items_per_step=B * T,
-                                  flops=flops, label=f"lstm_{cell}")
+    # the tunnel's per-call dispatch floor — see _slope_measure (flops for
+    # the MFU table come from the loop program's own cost analysis)
+    row, dt, flops = _slope_rate(step, x, carry, items_per_step=B * T,
+                                 label=f"lstm_{cell}", n_pair=(64, 576))
     return row, dt, flops
 
 
@@ -662,11 +653,10 @@ def bench_lstm_reference():
         updates, new_opt = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
-    _, flops = _aot(jax.jit(step), [x, (params, opt_state)])
     # same device-slope method as bench_lstm for an apples-to-apples ratio
-    row, _ = _slope_rate_guarded(step, x, (params, opt_state),
-                                 items_per_step=B * T, flops=flops,
-                                 label="lstm_flax")
+    row, _, _ = _slope_rate(step, x, (params, opt_state),
+                            items_per_step=B * T, label="lstm_flax",
+                            n_pair=(64, 576))
     return row
 
 
@@ -710,11 +700,11 @@ def bench_word2vec():
         return s0, s1, k2
 
     # device-slope timing: the SGNS step is well under the tunnel's per-call
-    # dispatch floor (see _loop_slope_time)
+    # dispatch floor (see _slope_measure)
     zero_salt = jnp.zeros((8, 128), jnp.float32)
-    row, _ = _slope_rate_guarded(wrapped, zero_salt, (syn0, syn1, key),
-                                 items_per_step=B, flops=None,
-                                 label="word2vec")
+    row, _, _ = _slope_rate(wrapped, zero_salt, (syn0, syn1, key),
+                            items_per_step=B, label="word2vec",
+                            n_pair=(64, 576))
     if isinstance(row, dict):
         return row
 
@@ -804,9 +794,10 @@ def bench_attention():
             continue
         step = make_step(fn)
         flops = full_flops * (0.5 if name == "fused" else 1.0)
-        row, dt = _slope_rate_guarded(step, zero, qkv,
-                                      items_per_step=B * T, flops=flops,
-                                      label=f"attention_{name}")
+        row, dt, _ = _slope_rate(step, zero, qkv,
+                                 items_per_step=B * T, flops=flops,
+                                 label=f"attention_{name}",
+                                 n_pair=(64, 576))
         out[name] = (row if isinstance(row, dict)
                      else {"tokens_per_sec": round(row, 1),
                            "step_ms": round(dt * 1e3, 3)})
@@ -816,6 +807,124 @@ def bench_attention():
         out["fused_vs_xla"] = round(
             fu["tokens_per_sec"] / xl["tokens_per_sec"], 3)
     return out
+
+
+_TLM = dict(V=4096, d=512, H=8, blocks=12, T=1024, B=8)
+
+
+def _tlm_flops():
+    """ANALYTIC per-train-step flop count for the transformer-LM config
+    (XLA's cost analysis cannot see inside the flash-attention custom
+    calls, so ours would be undercounted ~20%): per token, fwd =
+    blocks*(24*d^2 linears + 2*T*d causal attention) + 2*d*V head; train =
+    3x the linears (fwd+bwd) and 3.5x the attention (flash backward
+    recomputes scores in both kernel passes — same accounting as
+    bench_attention)."""
+    c = _TLM
+    per_tok = (3.0 * (c["blocks"] * 24.0 * c["d"] ** 2
+                      + 2.0 * c["d"] * c["V"])
+               + 3.5 * c["blocks"] * 2.0 * c["T"] * c["d"])
+    return per_tok * c["B"] * c["T"]
+
+
+def bench_transformer_lm():
+    """End-to-end transformer-LM train step, tokens/sec (the modern
+    analogue of the ResNet north-star): 12 pre-LN blocks, d_model=512,
+    8 heads (head dim 64 -> fused flash-attention path), T=1024, bf16,
+    token-id input via the EmbeddingSequenceLayer gather. Exercises flash
+    attention, LayerNorm, the CG executor, and Adam together."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    c = _TLM
+    net = transformer_lm(vocab_size=c["V"], d_model=c["d"],
+                         n_heads=c["H"], n_blocks=c["blocks"],
+                         max_length=c["T"], updater=Adam(3e-4),
+                         dtype="bfloat16", token_input=True).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, c["V"], (c["B"], c["T"]))
+    x = jnp.asarray(ids, jnp.int32)
+    y = jnp.asarray(np.eye(c["V"], dtype=np.float32)
+                    [np.roll(ids, 1, axis=1)], jnp.bfloat16)
+
+    def step(xs, carry):
+        params, state, opt_state, it, key = carry
+        def lf(p):
+            return net.loss_fn(p, state, xs, y, train=True, rng=key)
+        (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+        return new_params, new_state, new_opt, it + 1, key
+
+    carry = (net.params, net.state, net.opt_state,
+             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    row, dt, flops = _slope_rate(step, x, carry,
+                                 items_per_step=c["B"] * c["T"],
+                                 flops=_tlm_flops(), label="transformer_lm")
+    return row, dt, flops
+
+
+def bench_transformer_lm_flax():
+    """Independent flax.linen decoder-only LM, identical arch/config/
+    optimizer to bench_transformer_lm (nn.Embed + learned positions +
+    pre-LN MultiHeadDotProductAttention blocks — the stock XLA attention
+    path), bf16."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    import optax
+
+    c = _TLM
+    jdt = jnp.bfloat16
+
+    class LM(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            kw = dict(dtype=jdt, param_dtype=jdt)
+            x = nn.Embed(c["V"], c["d"], **kw)(ids)
+            pos = self.param("pos", nn.initializers.normal(0.02),
+                             (c["T"], c["d"]), jdt)
+            x = x + pos[None]
+            mask = nn.make_causal_mask(ids)
+            for _ in range(c["blocks"]):
+                y = nn.LayerNorm(**kw)(x)
+                y = nn.MultiHeadDotProductAttention(
+                    num_heads=c["H"], **kw)(y, y, mask=mask)
+                x = x + y
+                y = nn.LayerNorm(**kw)(x)
+                y = nn.Dense(4 * c["d"], **kw)(y)
+                y = nn.gelu(y)
+                y = nn.Dense(c["d"], **kw)(y)
+                x = x + y
+            x = nn.LayerNorm(**kw)(x)
+            return nn.Dense(c["V"], **kw)(x)
+
+    model = LM()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, c["V"], (c["B"], c["T"]))
+    x = jnp.asarray(ids, jnp.int32)
+    labels = jnp.asarray(np.roll(ids, 1, axis=1))
+    params = model.init(jax.random.PRNGKey(0), x)
+    tx = optax.adam(3e-4)
+    opt_state = tx.init(params)
+
+    def step(xs, carry):
+        params, opt_state = carry
+        def lf(p):
+            logits = model.apply(p, xs)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    # flax has no custom calls, so the loop program's own cost analysis is
+    # complete — no analytic override needed
+    row, dt, flops = _slope_rate(step, x, (params, opt_state),
+                                 items_per_step=c["B"] * c["T"],
+                                 label="transformer_lm_flax")
+    return row, dt, flops
 
 
 def bench_threshold_encode():
@@ -847,9 +956,8 @@ def bench_threshold_encode():
     try:
         compiled = jax.jit(lambda r: threshold_roundtrip(
             r, threshold=1e-3, capacity=n // 100)[1]).lower(g).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        floor_s = float(ca.get("bytes accessed", 2e8)) / (HBM_GBPS * 1e9)
+        floor_s = float(_cost_analysis(compiled).get("bytes accessed", 2e8)) \
+            / (HBM_GBPS * 1e9)
     except Exception:
         floor_s = 2e8 / (HBM_GBPS * 1e9)
     if dt < floor_s:
@@ -869,9 +977,8 @@ def bench_threshold_encode():
     try:
         compiled = jax.jit(
             lambda r: threshold_encode_dense(r, 1e-3)[1]).lower(g).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        dense_est = float(ca.get("bytes accessed", 2e8)) / (HBM_GBPS * 1e9)
+        dense_est = float(_cost_analysis(compiled).get("bytes accessed",
+                                                       2e8)) / (HBM_GBPS * 1e9)
         out["dense_est_ms"] = round(dense_est * 1e3, 3)
         out["dense_note"] = ("estimate = bytes_accessed / HBM bandwidth "
                              "(elementwise op, unmeasurably fast vs "
@@ -981,27 +1088,102 @@ def _stage(name, t0):
           file=sys.stderr, flush=True)
 
 
+RESULT = {
+    "metric": "resnet50_train_img_per_sec_per_chip",
+    "value": None, "invalid_reason": None, "unit": "img/sec",
+    "vs_baseline": None, "config": None, "extras": {}, "partial": True,
+}
+_DONE = False
+
+
+def _emit(final=False):
+    """Print the FULL result dict as one JSON line — called after EVERY
+    row (latest-line-wins: the driver parses the last line of stdout),
+    from the SIGTERM/SIGINT handler, and at exit. A kill at any point
+    therefore still leaves a complete, parseable artifact with every row
+    finished so far (BENCH_r04 was rc=124 with parsed=null because r4
+    printed once, at the very end)."""
+    RESULT["partial"] = not final
+    sys.stdout.write(json.dumps(RESULT) + "\n")
+    sys.stdout.flush()
+
+
+def _atexit_emit():  # an unhandled crash still flushes the rows done so far
+    if not _DONE:
+        _emit()
+
+
+class _RowTimeout(Exception):
+    """Raised by SIGALRM when a row exceeds its per-row wall-clock cap."""
+
+
 def main():
+    t_main = time.perf_counter()
+    # TOTAL wall-clock budget, warmup and core rows INCLUDED (r4's budget
+    # gated only the extras loop; the unbudgeted core rows alone outran
+    # the driver's timeout). Incremental emission makes an overrun
+    # harmless, but the budget keeps late rows from starving.
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    row_cap = float(os.environ.get("BENCH_ROW_CAP_S", "300"))
+    RESULT["config"] = {"batch": BATCH, "img": IMG, "dtype": "float32"}
+    extras = RESULT["extras"]
+    mfu = {}
+
+    def refresh():
+        """Recompute headline fields + derived ratios from the rows done
+        so far, so every emitted line is self-consistent."""
+        ours_row = extras.get("resnet50_f32_img_per_sec")
+        ours = _rowval(ours_row)
+        ref = _rowval(extras.get("resnet50_f32_flax_img_per_sec"))
+        RESULT["value"] = round(ours, 2) if ours else None
+        RESULT["invalid_reason"] = (ours_row.get("invalid_reason")
+                                    if isinstance(ours_row, dict) else None)
+        RESULT["vs_baseline"] = (round(ours / ref, 3)
+                                 if (ours and ref) else None)
+        for key, num, den in (
+                ("resnet50_bf16_vs_flax_bf16", "resnet50_bf16_img_per_sec",
+                 "resnet50_bf16_flax_img_per_sec"),
+                # plain-vs-plain: both sides are standard (no-peephole) LSTMs
+                ("lstm_vs_reference", "lstm_plain_tokens_per_sec",
+                 "lstm_reference_tokens_per_sec"),
+                ("transformer_lm_vs_flax", "transformer_lm_tokens_per_sec",
+                 "transformer_lm_flax_tokens_per_sec"),
+                # the measured pipeline tax: piped / device-resident
+                ("resnet50_piped_vs_resident", "resnet50_piped_img_per_sec",
+                 "resnet50_amp_img_per_sec")):
+            a, b = _rowval(extras.get(num)), _rowval(extras.get(den))
+            if a and b:
+                extras[key] = round(a / b, 3)
+        extras["mfu"] = {k: v for k, v in mfu.items() if v} or None
+
+    def on_term(sig, frame):
+        RESULT["terminated"] = f"signal {sig} mid-row"
+        refresh()
+        _emit()
+        os._exit(128 + sig)
+
+    def on_alarm(sig, frame):
+        raise _RowTimeout()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    signal.signal(signal.SIGALRM, on_alarm)
+    _emit()                 # skeleton line: parseable from second zero
+
     t0 = time.perf_counter()
     _global_warmup()
     _stage("warmup", t0)
-    mfu = {}
-    t0 = time.perf_counter()
-    ours_row, ours_dt, fl = bench_ours(label="resnet50_f32")
-    _stage("resnet50_f32_ours", t0)
-    mfu["resnet50_f32"] = _mfu_entry(ours_dt, "step(batch=%d)" % BATCH, fl)
-    ours = _rowval(ours_row)
-    t0 = time.perf_counter()
-    try:
-        ref_row, _, _ = bench_reference()
-        ref = _rowval(ref_row)
-    except Exception as e:
-        print(f"reference bench failed: {e}", file=sys.stderr)
-        ref = None
-    _stage("resnet50_f32_flax", t0)
-    ratio = (ours / ref) if (ours and ref) else None
 
     bf16_batch = BATCH if "BENCH_BATCH" in os.environ else 128
+
+    def _f32_ours():
+        row, dt, f = bench_ours(label="resnet50_f32")
+        mfu["resnet50_f32"] = _mfu_entry(dt, f"step(batch={BATCH})", f)
+        return row
+
+    def _f32_flax():
+        row, _, _ = bench_reference()
+        return row
 
     def _bf16_ours():
         # bf16 halves activation memory, so a larger batch fits and feeds
@@ -1033,68 +1215,84 @@ def main():
             mfu["lstm_plain"] = _mfu_entry(dt, "step(B=32,T=64)", f)
         return row
 
-    extras = {}
-    # hard wall-clock budget: the driver must ALWAYS get the JSON line, so
-    # extras are skipped (reported null) once the budget is spent
-    # slope-timed LSTM stages compile two loop programs each; 480s starved
-    # the tail extras (r3), hence the raised default
-    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
-    t_start = time.perf_counter()
+    def _tlm_ours():
+        row, dt, f = bench_transformer_lm()
+        mfu["transformer_lm"] = _mfu_entry(
+            dt, f"step(B={_TLM['B']},T={_TLM['T']})", f)
+        return row
+
+    def _tlm_flax():
+        row, dt, f = bench_transformer_lm_flax()
+        mfu["transformer_lm_flax"] = _mfu_entry(
+            dt, f"step(B={_TLM['B']},T={_TLM['T']})", f)
+        return row
+
+    # headline-first, per family: each row's result is on stdout before
+    # the next row starts, so a driver kill only costs the rows not yet
+    # reached — never the ones already measured
+    rows = [("resnet50_f32_img_per_sec", _f32_ours),
+            ("resnet50_f32_flax_img_per_sec", _f32_flax)]
     if os.environ.get("BENCH_SKIP_EXTRAS", "0") != "1":
-        for name, fn in [
+        rows += [
             ("resnet50_bf16_img_per_sec", _bf16_ours),
             ("resnet50_bf16_flax_img_per_sec", _bf16_flax),
-            ("resnet50_amp_img_per_sec", _amp_ours),
-            ("resnet50_piped_img_per_sec", _piped),
-            ("lstm_train_tokens_per_sec", _lstm),
             ("lstm_plain_tokens_per_sec", lambda: _lstm("plain")),
             ("lstm_reference_tokens_per_sec", bench_lstm_reference),
+            ("lstm_train_tokens_per_sec", _lstm),
             ("word2vec_words_per_sec", bench_word2vec),
             ("attention_long_context", bench_attention),
+            ("transformer_lm_tokens_per_sec", _tlm_ours),
+            ("transformer_lm_flax_tokens_per_sec", _tlm_flax),
+            ("resnet50_amp_img_per_sec", _amp_ours),
+            ("resnet50_piped_img_per_sec", _piped),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overhead_by_mesh", bench_collective_overhead),
-        ]:
-            if time.perf_counter() - t_start > budget:
-                print(f"extra bench {name} skipped: budget exhausted",
-                      file=sys.stderr)
-                extras[name] = None
-                continue
-            t0 = time.perf_counter()
-            try:
-                v = fn()
-                extras[name] = round(v, 3) if isinstance(v, float) else v
-            except Exception as e:
-                print(f"extra bench {name} failed: {e}", file=sys.stderr)
-                extras[name] = None
-            _stage(name, t0)
-        lp = _rowval(extras.get("lstm_plain_tokens_per_sec"))
-        lr = _rowval(extras.get("lstm_reference_tokens_per_sec"))
-        if lp and lr:
-            # plain-vs-plain: both sides are standard (no-peephole) LSTMs
-            extras["lstm_vs_reference"] = round(lp / lr, 3)
-        ob = _rowval(extras.get("resnet50_bf16_img_per_sec"))
-        fb = _rowval(extras.get("resnet50_bf16_flax_img_per_sec"))
-        if ob and fb:
-            extras["resnet50_bf16_vs_flax_bf16"] = round(ob / fb, 3)
-        pa = _rowval(extras.get("resnet50_piped_img_per_sec"))
-        aa = _rowval(extras.get("resnet50_amp_img_per_sec"))
-        if pa and aa:
-            # the measured pipeline tax: piped / device-resident
-            extras["resnet50_piped_vs_resident"] = round(pa / aa, 3)
-    # the headline f32 MFU is computed regardless of BENCH_SKIP_EXTRAS
-    extras["mfu"] = {k: v for k, v in mfu.items() if v} or None
+        ]
 
-    print(json.dumps({
-        "metric": "resnet50_train_img_per_sec_per_chip",
-        "value": round(ours, 2) if ours else None,
-        "invalid_reason": (ours_row.get("invalid_reason")
-                           if isinstance(ours_row, dict) else None),
-        "unit": "img/sec",
-        "vs_baseline": round(ratio, 3) if ratio else None,
-        "config": {"batch": BATCH, "img": IMG, "dtype": "float32"},
-        "extras": extras,
-    }))
+    for name, fn in rows:
+        elapsed = time.perf_counter() - t_main
+        if elapsed > budget:
+            print(f"[bench] {name} skipped: budget exhausted "
+                  f"({elapsed:.0f}s > {budget:.0f}s)", file=sys.stderr)
+            extras[name] = None
+            refresh()
+            _emit()
+            continue
+        t0 = time.perf_counter()
+        # per-row cap: a pathologically SLOW row (compile storm, repeated
+        # retries) forfeits itself instead of starving every row behind
+        # it. Caveat: SIGALRM fires between Python bytecodes, so a single
+        # C call that never returns (a hard tunnel hang inside one
+        # readback) is not interruptible from in-process — in that case
+        # the per-row emission above still bounds the loss to the stuck
+        # row and later rows, which only the driver's kill can reclaim.
+        # The collective row manages its own 420s subprocess timeout.
+        cap = 460.0 if name == "collective_overhead_by_mesh" else \
+            min(row_cap, budget - elapsed + 60.0)
+        signal.setitimer(signal.ITIMER_REAL, cap)
+        try:
+            v = fn()
+            extras[name] = round(v, 3) if isinstance(v, float) else v
+        except _RowTimeout:
+            print(f"[bench] {name} hit its {cap:.0f}s row cap",
+                  file=sys.stderr)
+            extras[name] = {"value": None,
+                            "invalid_reason": f"row exceeded {cap:.0f}s cap"}
+        except Exception as e:
+            print(f"extra bench {name} failed: {e}", file=sys.stderr)
+            extras[name] = None
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+        refresh()
+        _emit()
+        _stage(name, t0)
+
+    refresh()
+    global _DONE
+    _emit(final=True)
+    _DONE = True
 
 
 if __name__ == "__main__":
+    atexit.register(_atexit_emit)
     main()

@@ -65,6 +65,13 @@ class Evaluation:
             m = np.asarray(mask).reshape(-1).astype(bool)
             labels, predictions = labels[m], predictions[m]
             if record_meta_data is not None:
+                # length-check against the PRE-mask row count: zip would
+                # silently truncate a misaligned list and the post-filter
+                # guard below could then pass with wrong records attached
+                if len(record_meta_data) != len(m):
+                    raise ValueError(
+                        f"record_meta_data has {len(record_meta_data)} "
+                        f"entries for {len(m)} pre-mask examples")
                 record_meta_data = [md for md, keep in
                                     zip(record_meta_data, m) if keep]
         if labels.ndim == 2:

@@ -1,0 +1,6 @@
+"""Interop with the Java reference's on-disk formats."""
+from .dl4j_zip import (import_dl4j_zip, is_dl4j_zip, read_nd4j_array,
+                       write_nd4j_array)
+
+__all__ = ["import_dl4j_zip", "is_dl4j_zip", "read_nd4j_array",
+           "write_nd4j_array"]

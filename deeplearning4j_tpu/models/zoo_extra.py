@@ -333,24 +333,33 @@ def sample_text(net, *, vocab_size: int, seed_ids, n_steps: int,
 def transformer_lm(vocab_size: int = 256, *, d_model: int = 256,
                    n_heads: int = 2, n_blocks: int = 2,
                    max_length: int = 1024, seed: int = 12345, updater=None,
-                   dtype: str = "float32") -> ComputationGraph:
+                   dtype: str = "float32",
+                   token_input: bool = False) -> ComputationGraph:
     """Decoder-only transformer LM (net-new beyond the reference zoo — its
     era predates transformers): pre-LN blocks of causal self-attention +
     gelu MLP with residual adds, LayerNorm head, time-distributed softmax.
 
     On TPU the attention rides the fused Pallas flash kernels
-    (ops/pallas_attention.py) whenever d_model/n_heads is a multiple of
-    128 and the sequence length tiles by 128; elsewhere it falls back to
-    the XLA path with identical numerics. For sequences beyond one chip,
-    shard the time axis with parallel.ring_attention instead.
-    """
-    from ..nn.layers import (LayerNormalization, PositionalEmbeddingLayer,
-                             SelfAttentionLayer)
+    (ops/pallas_attention.py) whenever the head dim is 64, 96, or a
+    multiple of 128 and the sequence length tiles by 128; elsewhere it
+    falls back to the XLA path with identical numerics. For sequences
+    beyond one chip, shard the time axis with parallel.ring_attention
+    instead.
 
+    ``token_input=True`` feeds [B,T] integer token ids through an
+    EmbeddingSequenceLayer gather (the TPU-first input path — O(B*T*d)
+    HBM traffic); the default keeps the original one-hot [B,T,V] contract
+    for drop-in parity with the char-RNN zoo models.
+    """
+    from ..nn.layers import (EmbeddingSequenceLayer, LayerNormalization,
+                             PositionalEmbeddingLayer, SelfAttentionLayer)
+
+    embed = (EmbeddingSequenceLayer(n_in=vocab_size, n_out=d_model)
+             if token_input
+             else DenseLayer(n_out=d_model, activation="identity"))
     g = (_base_builder(seed, updater or Adam(3e-4), dtype=dtype)
          .add_inputs("tokens")
-         .add_layer("embed", DenseLayer(n_out=d_model, activation="identity"),
-                    "tokens")
+         .add_layer("embed", embed, "tokens")
          .add_layer("pos", PositionalEmbeddingLayer(n_out=d_model,
                                                     max_length=max_length),
                     "embed"))
@@ -379,5 +388,6 @@ def transformer_lm(vocab_size: int = 256, *, d_model: int = 256,
                                             activation="softmax",
                                             loss="mcxent"), "ln_f")
           .set_outputs("head")
-          .set_input_types(InputType.recurrent(vocab_size, max_length)))
+          .set_input_types(InputType.recurrent(
+              1 if token_input else vocab_size, max_length)))
     return ComputationGraph(g.build())

@@ -194,8 +194,22 @@ class NativeNpzFile:
         order = "F" if lib.sr_member_fortran(self._h, i) else "C"
         out = np.empty(tuple(shape[:ndim]), dtype=np.dtype(descr),
                        order=order)
-        assert out.nbytes == lib.sr_member_nbytes(self._h, i)
+        nbytes = lib.sr_member_nbytes(self._h, i)
+        if out.nbytes != nbytes:
+            # parse_npy's element-size heuristic disagreed with numpy's
+            # itemsize for this descr — an unchecked memcpy here would
+            # silently corrupt, so refuse instead
+            raise ValueError(
+                f"member {name!r}: descr {descr!r} implies {out.nbytes} "
+                f"bytes but native header says {nbytes}")
         lib.sr_read(self._h, i, out.ctypes.data_as(ctypes.c_void_p))
+        if out.dtype.kind == "V" and out.dtype.itemsize == 2:
+            # np.savez stores ml_dtypes bfloat16 as raw '|V2' (np.load
+            # returns the same). The shard format's only 2-byte void
+            # producer is bf16 (datasets/export.py), so view it back —
+            # same recovery as util/distributed_checkpoint.py.
+            import ml_dtypes
+            out = out.view(ml_dtypes.bfloat16)
         return out
 
     def close(self):

@@ -1,6 +1,7 @@
 from .base import LayerConf
 from .core import (ActivationLayer, AutoEncoder, CenterLossOutputLayer,
-                   DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
+                   DenseLayer, DropoutLayer, EmbeddingLayer,
+                   EmbeddingSequenceLayer, LossLayer,
                    PositionalEmbeddingLayer,
                    OutputLayer, RnnOutputLayer)
 from .conv import (Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer,
@@ -23,7 +24,8 @@ __all__ = [
     "ExponentialReconstructionDistribution", "GaussianReconstructionDistribution",
     "LossFunctionWrapper", "RBM", "VariationalAutoencoder",
     "LayerConf", "ActivationLayer", "AutoEncoder", "CenterLossOutputLayer",
-    "DenseLayer", "DropoutLayer", "EmbeddingLayer", "LossLayer", "OutputLayer",
+    "DenseLayer", "DropoutLayer", "EmbeddingLayer", "EmbeddingSequenceLayer",
+    "LossLayer", "OutputLayer",
     "PositionalEmbeddingLayer",
     "RnnOutputLayer", "Convolution1DLayer", "ConvolutionLayer",
     "GlobalPoolingLayer", "SubsamplingLayer", "Subsampling1DLayer",

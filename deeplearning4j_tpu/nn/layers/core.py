@@ -103,6 +103,44 @@ class EmbeddingLayer(LayerConf):
 
 @register
 @dataclass
+class EmbeddingSequenceLayer(LayerConf):
+    """Sequence of token ids -> sequence of vectors: [B,T] (or [B,T,1])
+    int ids -> [B,T,n_out] (reference
+    nn/conf/layers/EmbeddingSequenceLayer.java). ONE gather instead of a
+    one-hot matmul — the TPU-first input path for transformer/RNN LMs:
+    HBM traffic O(B*T*d) instead of O(B*T*V), backward is the scatter-add
+    XLA emits natively. Declare the graph input as
+    ``InputType.recurrent(1, T)`` (one index per timestep)."""
+    n_in: Optional[int] = None     # vocab size (required)
+    n_out: int = 0
+
+    param_order: ClassVar[Tuple[str, ...]] = ("W",)
+    expected_input: ClassVar[str] = "any"
+
+    def output_type(self, itype):
+        T = getattr(itype, "timestep_length", -1)
+        return InputTypeRecurrent(self.n_out, T)
+
+    def init(self, rng, itype, dtype):
+        if not self.n_in:
+            raise ValueError("EmbeddingSequenceLayer needs n_in (the vocab "
+                             "size) — it cannot be inferred from a [B,T] "
+                             "index input")
+        W = self._winit(rng, (self.n_in, self.n_out), self.n_in, self.n_out,
+                        dtype)
+        return {"W": W}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        idx = x
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        idx = idx.astype(jnp.int32)
+        out = params["W"][idx]
+        return self.act(maybe_dropout(out, self.dropout, rng, train)), state
+
+
+@register
+@dataclass
 class PositionalEmbeddingLayer(LayerConf):
     """Learned absolute positional embeddings added to [B,T,F] activations
     (net-new — required for order-aware attention stacks like

@@ -56,9 +56,15 @@ def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
     dt = jnp.dtype(dtype)
     if dt not in (jnp.float32, jnp.dtype(jnp.bfloat16)):
         return False
-    if D % 128 != 0 or T % 128 != 0 or T < 256:
-        # D is the lane dimension (must tile by 128); tiny T isn't worth
-        # the pallas_call overhead vs one fused XLA softmax
+    if T % 128 != 0 or T < 256:
+        # tiny T isn't worth the pallas_call overhead vs one fused XLA
+        # softmax
+        return False
+    if D % 128 != 0 and D not in (64, 96):
+        # D is the lane dimension: multiples of the 128-lane tile are
+        # native; 64/96 (GPT-2-class head dims) ride Mosaic's minor-dim
+        # padding — the MXU pads the QK^T contraction to 128 either way,
+        # so the only cost is padded q/k/v/o tiles in VMEM
         return False
     backend = jax.default_backend()
     if backend == "tpu":
@@ -73,11 +79,31 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block(T: int) -> int:
-    for b in (512, 256, 128):
-        if T % b == 0:
+def _blocks(T: int) -> tuple:
+    """(BQ, BK) block sizes. Defaults come from the v5e autotune sweep
+    (tools/autotune_attention.py; see BASELINE.md's attention roofline
+    note — the same preference order won at every head dim tried);
+    DL4J_TPU_ATTN_BQ / DL4J_TPU_ATTN_BK override for re-tuning."""
+    def pick(env, pref):
+        v = os.environ.get(env)
+        if v:
+            b = int(v)
+            if T % b:
+                raise ValueError(f"{env}={b} does not divide T={T}")
             return b
-    raise ValueError(f"T={T} not a multiple of 128")
+        for b in pref:
+            if T % b == 0:
+                return b
+        raise ValueError(f"T={T} not a multiple of 128")
+    # v5e sweep @ T=2048 (B=4,H=8, causal fwd+bwd): BK=1024 beats the old
+    # BQ=BK=512 default at every head dim tried (D=128: 2.17 vs 2.75
+    # ms/step; D=64: consistently top-2 across repeated sweeps) — bigger
+    # k-blocks amortize the online-softmax carry updates and feed the MXU
+    # longer contractions. BK=2048 was no better and BQ=1024 failed to
+    # compile with it, so 512/1024 is the stable optimum.
+    pref_q = (512, 256, 128)
+    pref_k = (1024, 512, 256, 128)
+    return pick("DL4J_TPU_ATTN_BQ", pref_q), pick("DL4J_TPU_ATTN_BK", pref_k)
 
 
 def _causal_mask_block(i, j, BQ, BK, s):
@@ -134,7 +160,7 @@ def _fwd_body(causal, masked, scale, BQ, BK, *refs):
 def _fwd(q3, k3, v3, mask2, causal, scale):
     """q3/k3/v3: [BH, T, D]; mask2: [B, T] or None. Returns (o, lse)."""
     BH, T, D = q3.shape
-    BQ = BK = _block(T)
+    BQ, BK = _blocks(T)
     grid = (BH, T // BQ, T // BK)
     in_specs = [
         pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
@@ -258,7 +284,7 @@ def _dkv_body(causal, masked, scale, BQ, BK, *refs):
 
 def _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3):
     BH, T, D = q3.shape
-    BQ = BK = _block(T)
+    BQ, BK = _blocks(T)
     masked = mask2 is not None
     # delta = rowsum(dO * O), lane-replicated like lse
     delta = jnp.sum(do3.astype(f32) * o3.astype(f32), axis=-1)
@@ -347,7 +373,10 @@ def _flash_fwd(q3, k3, v3, mask2, causal, scale):
 def _flash_bwd(causal, scale, res, do3):
     q3, k3, v3, mask2, o3, lse = res
     dq, dk, dv = _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3)
-    return dq, dk, dv, None
+    # mask2 is a traced array operand when present: an explicit zero
+    # cotangent is version-stable, None-for-array is not
+    dmask = None if mask2 is None else jnp.zeros_like(mask2)
+    return dq, dk, dv, dmask
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

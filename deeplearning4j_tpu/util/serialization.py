@@ -119,6 +119,10 @@ def restore_model(path: str, load_updater: bool = True):
                 if manifest.get("model_class") == "ComputationGraph":
                     return restore_computation_graph(path, load_updater)
                 return restore_multilayer_network(path, load_updater)
+        from ..interop.dl4j_zip import import_dl4j_zip, is_dl4j_zip
+        if is_dl4j_zip(path):
+            # a zip saved by the JAVA reference (ModelSerializer.java:79-96)
+            return import_dl4j_zip(path, load_updater=load_updater)
         raise ValueError(f"{path}: zip but not a deeplearning4j_tpu model")
     # try config JSON
     try:

@@ -192,3 +192,37 @@ def test_transformer_lm_zoo_model_trains():
         ob = np.asarray(net.output(xb))[0, 10]
         assert not np.allclose(oa, ob, atol=1e-6), \
             "decoder is position-blind"
+
+
+def test_transformer_lm_token_input_trains():
+    """token_input=True feeds [B,T] int ids through the
+    EmbeddingSequenceLayer gather and learns the same shift-by-one task
+    (the TPU-first input path used by the transformer-LM bench row)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration)
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    from deeplearning4j_tpu.optimize.updaters import Adam as _Adam
+
+    V, T, B = 12, 32, 8
+    net = transformer_lm(vocab_size=V, d_model=32, n_heads=2, n_blocks=2,
+                         max_length=T, updater=_Adam(3e-3),
+                         token_input=True).init()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, V, (B, T)).astype(np.int32)
+    y = np.eye(V, dtype=np.float32)[np.roll(ids, 1, axis=1)]
+    assert np.asarray(net.output(ids)).shape == (B, T, V)
+    s0 = net.score(ids, y)
+    net.fit(ids, y, epochs=60)
+    assert net.score(ids, y) < 0.5 * s0
+    # serde round-trip preserves the structure
+    conf2 = ComputationGraphConfiguration.from_json(net.conf.to_json())
+    net2 = ComputationGraph(conf2).init()
+    assert net2.num_params() == net.num_params()
+    # cross-path invariant: the gather embed carries V*d weights but no
+    # bias, so it sits exactly d_model params under the one-hot Dense path
+    onehot = transformer_lm(vocab_size=V, d_model=32, n_heads=2, n_blocks=2,
+                            max_length=T, token_input=False).init()
+    assert net.num_params() == onehot.num_params() - 32

@@ -183,3 +183,14 @@ def test_evaluate_with_metadata_on_timeseries_does_not_crash():
     e = net.evaluate(iter([ds]))
     assert e.count == 20                       # 5 sequences x 4 steps
     assert e.get_prediction_errors() is None   # no per-example records
+
+
+def test_meta_mask_length_mismatch_raises():
+    """Metadata shorter than the PRE-mask row count must raise, not be
+    zip-truncated into misattributed records (advisor r4 finding)."""
+    e = Evaluation()
+    labels = np.eye(2)[[0, 1, 1]]
+    preds = _probs([[.9, .1], [.8, .2], [.3, .7]])
+    mask = np.asarray([1, 1, 0])
+    with pytest.raises(ValueError, match="pre-mask"):
+        e.eval(labels, preds, mask=mask, record_meta_data=["a", "b"])

@@ -29,10 +29,36 @@ def _mask():
 def test_applicability_probe():
     assert fused_attention_applicable(B, H, T, D, jnp.float32)
     assert fused_attention_applicable(B, H, T, D, jnp.bfloat16)
-    assert not fused_attention_applicable(B, H, T, 64, jnp.float32)   # D%128
+    # GPT-2-class head dims ride Mosaic's minor-dim padding (round-5)
+    assert fused_attention_applicable(B, H, T, 64, jnp.float32)
+    assert fused_attention_applicable(B, H, T, 96, jnp.float32)
+    assert not fused_attention_applicable(B, H, T, 80, jnp.float32)   # odd D
     assert not fused_attention_applicable(B, H, 200, D, jnp.float32)  # T%128
     assert not fused_attention_applicable(B, H, 128, D, jnp.float32)  # tiny T
     assert not fused_attention_applicable(B, H, T, D, jnp.float64)
+
+
+@pytest.mark.parametrize("d", [64, 96])
+def test_small_head_dim_parity(d):
+    """D=64/96 (the common transformer head dims) engage the fused path
+    and match the XLA reference, gradients included."""
+    q, k, v = (jnp.asarray(R.normal(size=(B, H, T, d)), jnp.float32)
+               for _ in range(3))
+    km = _mask()
+    ours = flash_attention(q, k, v, causal=True, key_mask=km)
+    ref = attention(q, k, v, causal=True, key_mask=km)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+    def lf(fn):
+        def loss(q, k, v):
+            out = fn(q, k, v, causal=True, key_mask=km)
+            return jnp.sum(out * out)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for name, a, b in zip("qkv", lf(flash_attention), lf(attention)):
+        rel = (float(jnp.max(jnp.abs(a - b)))
+               / (float(jnp.max(jnp.abs(b))) + 1e-9))
+        assert rel < 1e-4, (name, rel)
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -58,6 +84,33 @@ def test_gradient_parity_causal_masked():
     g_fused = lf(flash_attention)
     g_ref = lf(attention)
     for name, a, b in zip("qkv", g_fused, g_ref):
+        rel = (float(jnp.max(jnp.abs(a - b)))
+               / (float(jnp.max(jnp.abs(b))) + 1e-9))
+        assert rel < 1e-4, (name, rel)
+
+
+def test_asymmetric_blocks_parity_t1024():
+    """T>=1024 selects the autotuned ASYMMETRIC default (BQ=512, BK=1024)
+    — the config every real model run uses. Parity incl. gradients guards
+    kernel edits that are only correct when BQ == BK."""
+    from deeplearning4j_tpu.ops.pallas_attention import _blocks
+    assert _blocks(1024) == (512, 1024)
+    T2 = 1024
+    q, k, v = (jnp.asarray(R.normal(size=(1, 2, T2, 64)), jnp.float32)
+               for _ in range(3))
+    km = jnp.asarray((np.arange(T2)[None, :] <
+                      np.asarray([700, 1024])[:, None]).astype(np.float32))
+    km = km[:1]
+    ours = flash_attention(q, k, v, causal=True, key_mask=km)
+    ref = attention(q, k, v, causal=True, key_mask=km)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+    def lf(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True, key_mask=km) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    for name, a, b in zip("qkv", lf(flash_attention), lf(attention)):
         rel = (float(jnp.max(jnp.abs(a - b)))
                / (float(jnp.max(jnp.abs(b))) + 1e-9))
         assert rel < 1e-4, (name, rel)

@@ -1,0 +1,121 @@
+"""Generate reference-format DL4J model zips for the interop tests.
+
+No Java runtime exists on this rig, so these fixtures are hand-built to
+the Java writer's byte layout (util/ModelSerializer.java:79-96 for the
+zip, nd4j Nd4j.write for the binary buffers, the pre-0.7.2 legacy string
+dialect for activation/loss — the dialect the 0.8 reader itself accepts,
+MultiLayerConfiguration.java:145-255). The MLP fixture mirrors
+regressiontest/RegressionTest080.java's MLP_1 case: dense(3->4, relu) +
+output(4->5, softmax, MCXENT), Nesterovs(0.15, 0.9), params =
+linspace(1..N), updater state = linspace(1..N) — so the import test can
+assert the same facts the Java regression test asserts.
+
+Run from the repo root:  python tools/build_dl4j_fixtures.py
+"""
+import json
+import os
+import sys
+import zipfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.interop.dl4j_zip import write_nd4j_array
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "dl4j")
+
+
+def _conf(layer_wrapper, seed=12345, extra=None):
+    c = {"seed": seed, "pretrain": False, **(extra or {}),
+         "layer": layer_wrapper}
+    return c
+
+
+def _base_layer(name, act, nin, nout, **kw):
+    d = {"layerName": name, "activationFunction": act, "nin": nin,
+         "nout": nout, "weightInit": "XAVIER", "biasInit": 0.0,
+         "learningRate": 0.15, "momentum": 0.9, "updater": "NESTEROVS",
+         "l1": 0.0, "l2": 0.0, "dropOut": 0.0}
+    d.update(kw)
+    return d
+
+
+def mlp_fixture(path):
+    """RegressionTest080.regressionTestMLP1's architecture, linspace
+    params/updater — restore must reproduce these exactly."""
+    conf = {
+        "backprop": True, "pretrain": False, "backpropType": "Standard",
+        "confs": [
+            _conf({"dense": _base_layer("layer0", "relu", 3, 4)}),
+            _conf({"output": _base_layer("layer1", "softmax", 4, 5,
+                                         lossFunction="MCXENT")}),
+        ],
+        "inputPreProcessors": {},
+    }
+    n = 3 * 4 + 4 + 4 * 5 + 5
+    params = np.linspace(1, n, n).astype(np.float32).reshape(1, n)
+    upd = np.linspace(1, n, n).astype(np.float32).reshape(1, n)
+    _write_zip(path, conf, params, upd)
+
+
+def lenet_fixture(path):
+    """A LeNet-style CNN on flattened 1x8x8 images (the Java net's
+    feedForwardToCnn/cnnToFeedForward preprocessor sandwich): conv 3x3
+    1->4 relu, maxpool 2x2, dense 16 relu, output 3 softmax. Weights are
+    seeded-random, written in the Java layouts ('c' [out,in,kh,kw] conv
+    kernels, 'f' dense matrices)."""
+    conv = _base_layer("conv0", "relu", 1, 4)
+    conv.update({"kernelSize": [3, 3], "stride": [1, 1], "padding": [0, 0],
+                 "convolutionMode": "Truncate"})
+    sub = {"layerName": "pool0", "poolingType": "MAX", "kernelSize": [2, 2],
+           "stride": [2, 2], "padding": [0, 0],
+           "convolutionMode": "Truncate"}
+    # conv output 6x6x4 -> pool 3x3x4 -> flatten 36
+    conf = {
+        "backprop": True, "pretrain": False, "backpropType": "Standard",
+        "confs": [
+            _conf({"convolution": conv}),
+            _conf({"subsampling": sub}),
+            _conf({"dense": _base_layer("dense0", "relu", 36, 16)}),
+            _conf({"output": _base_layer("out", "softmax", 16, 3,
+                                         lossFunction="MCXENT")}),
+        ],
+        "inputPreProcessors": {
+            "0": {"feedForwardToCnn": {"inputHeight": 8, "inputWidth": 8,
+                                       "numChannels": 1}},
+            "2": {"cnnToFeedForward": {"inputHeight": 3, "inputWidth": 3,
+                                       "numChannels": 4}},
+        },
+    }
+    r = np.random.default_rng(42)
+    convW = r.normal(0, 0.3, (4, 1, 3, 3)).astype(np.float32)   # [out,in,kh,kw]
+    convb = r.normal(0, 0.1, (4,)).astype(np.float32)
+    dW = r.normal(0, 0.2, (36, 16)).astype(np.float32)          # [nin,nout]
+    db = r.normal(0, 0.1, (16,)).astype(np.float32)
+    oW = r.normal(0, 0.2, (16, 3)).astype(np.float32)
+    ob = r.normal(0, 0.1, (3,)).astype(np.float32)
+    flat = np.concatenate([convW.ravel(order="C"), convb,
+                           dW.ravel(order="F"), db,
+                           oW.ravel(order="F"), ob]).astype(np.float32)
+    np.save(os.path.join(OUT, "lenet_raw_weights.npy"),
+            {"convW": convW, "convb": convb, "dW": dW, "db": db,
+             "oW": oW, "ob": ob}, allow_pickle=True)
+    _write_zip(path, conf, flat.reshape(1, -1), None)
+
+
+def _write_zip(path, conf, params, updater_state):
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin", write_nd4j_array(params, order="c"))
+        if updater_state is not None:
+            z.writestr("updaterState.bin",
+                       write_nd4j_array(updater_state, order="c"))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    os.makedirs(OUT, exist_ok=True)
+    mlp_fixture(os.path.join(OUT, "080_mlp_3_4_5.zip"))
+    lenet_fixture(os.path.join(OUT, "080_lenet_flat_8x8.zip"))

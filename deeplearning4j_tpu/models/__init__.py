@@ -1,4 +1,4 @@
-from .lenet import lenet
+from .lenet import digits_cnn, lenet
 from .pretrained import adler32_of, fetch_cached, init_pretrained
 from .zoo import alexnet, resnet50, simple_cnn, vgg16, vgg19
 from .zoo_extra import (facenet_nn4_small2, googlenet, inception_resnet_v1,
@@ -6,7 +6,7 @@ from .zoo_extra import (facenet_nn4_small2, googlenet, inception_resnet_v1,
 
 __all__ = [
     "adler32_of", "alexnet", "facenet_nn4_small2", "fetch_cached",
-    "googlenet", "inception_resnet_v1", "init_pretrained", "lenet",
+    "digits_cnn", "googlenet", "inception_resnet_v1", "init_pretrained", "lenet",
     "resnet50", "simple_cnn", "text_generation_lstm", "transformer_lm",
     "vgg16", "vgg19",
 ]

@@ -46,19 +46,17 @@ f32 = jnp.float32
 NEG = -1e30
 
 
-def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
-    """Probe: can the fused kernels handle this call? (helper seam —
-    callers fall back to the XLA path when False)."""
+def _kernel_eligible(D: int, dtype) -> bool:
+    """The eligibility policy SHARED by every fused-attention probe
+    (single-device and ring): Pallas present + not env-disabled, dtype,
+    head-dim, and backend rules. Per-probe sequence-length rules layer on
+    top."""
     if not PALLAS_AVAILABLE:
         return False
     if os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") == "0":
         return False
     dt = jnp.dtype(dtype)
     if dt not in (jnp.float32, jnp.dtype(jnp.bfloat16)):
-        return False
-    if T % 128 != 0 or T < 256:
-        # tiny T isn't worth the pallas_call overhead vs one fused XLA
-        # softmax
         return False
     if D % 128 != 0 and D not in (64, 96):
         # D is the lane dimension: multiples of the 128-lane tile are
@@ -73,6 +71,13 @@ def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
         # interpreter is for parity tests only (see ops/pallas_lstm.py)
         return os.environ.get("DL4J_TPU_FUSED_ATTN_INTERPRET", "0") == "1"
     return False
+
+
+def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
+    """Probe: can the fused kernels handle this call? (helper seam —
+    callers fall back to the XLA path when False)."""
+    # tiny T isn't worth the pallas_call overhead vs one fused XLA softmax
+    return _kernel_eligible(D, dtype) and T % 128 == 0 and T >= 256
 
 
 def _interpret() -> bool:
@@ -356,6 +361,101 @@ def _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3):
         interpret=_interpret(),
     )(*args)
     return dq, dk, dv
+
+
+# ------------------------------------------------- ring-hop carry kernel
+def _fwd_carry_body(causal, scale, BQ, BK, *refs):
+    """One ring hop's local block, CARRY-EMITTING: the online-softmax
+    state (acc, m, l) enters as kernel inputs and leaves raw (no
+    normalize) so the ring can keep folding hops in. Same recurrence as
+    _fwd_body; m/l ride the lane-replicated [.,128] layout between hops."""
+    (q_ref, k_ref, v_ref, acc_in, m_in, l_in,
+     acc_out, m_out, l_out, accs, ms, ls) = refs
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        accs[:] = acc_in[0]
+        ms[:] = m_in[0]
+        ls[:] = l_in[0]
+
+    compute = True if not causal else (j * BK < (i + 1) * BQ)
+
+    @pl.when(compute)
+    def _update():
+        q = q_ref[0].astype(f32)
+        k = k_ref[0].astype(f32)
+        v = v_ref[0].astype(f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=f32) * scale
+        if causal:
+            s = _causal_mask_block(i, j, BQ, BK, s)
+        m_prev = ms[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        ls[:] = jnp.broadcast_to(ls[:, :1] * corr + p.sum(1, keepdims=True),
+                                 ls.shape)
+        accs[:] = accs[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+        ms[:] = jnp.broadcast_to(m_new, ms.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        acc_out[0] = accs[:]
+        m_out[0] = ms[:]
+        l_out[0] = ls[:]
+
+
+def flash_block_update(acc, m, l, q3, k3, v3, *, causal: bool,
+                       scale: float):
+    """Fused one-hop update for ring attention: fold the local [BH,Tq,D] x
+    [BH,Tk,D] block into the running online-softmax carry WITHOUT
+    materializing the [Tq,Tk] scores in HBM (the XLA ring body's
+    _block_update does — parallel/ring_attention.py). acc [BH,Tq,D] f32;
+    m/l lane-replicated [BH,Tq,128] f32. Returns the updated carry, raw
+    (caller normalizes after the last hop)."""
+    BH, Tq, D = q3.shape
+    Tk = k3.shape[1]
+    BQ, _ = _blocks(Tq)
+    _, BK = _blocks(Tk)
+    grid = (BH, Tq // BQ, Tk // BK)
+    qspec = pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, BK, D), lambda b, i, j: (b, j, 0))
+    lspec = pl.BlockSpec((1, BQ, 128), lambda b, i, j: (b, i, 0))
+    return pl.pallas_call(
+        functools.partial(_fwd_carry_body, causal, scale, BQ, BK),
+        grid=grid,
+        in_specs=[qspec, kspec, kspec, qspec, lspec, lspec],
+        out_specs=[qspec, lspec, lspec],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, D), f32),
+                   jax.ShapeDtypeStruct((BH, Tq, 128), f32),
+                   jax.ShapeDtypeStruct((BH, Tq, 128), f32)],
+        scratch_shapes=[pltpu.VMEM((BQ, D), f32),
+                        pltpu.VMEM((BQ, 128), f32),
+                        pltpu.VMEM((BQ, 128), f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3, acc, m, l)
+
+
+def flash_block_bwd(q3, k3, v3, o3, lse, do3, *, causal: bool,
+                    scale: float):
+    """One ring hop's backward contribution (FlashAttention-2 math with
+    the GLOBAL logsumexp, so per-hop contributions sum exactly): returns
+    (dq_contrib, dk, dv) for this (q, k-block) pair via the existing
+    fused _dq/_dkv kernels."""
+    return _bwd(q3, k3, v3, None, causal, scale, o3, lse, do3)
+
+
+def fused_ring_applicable(t_local: int, D: int, dtype) -> bool:
+    """Probe for the fused ring-hop kernels (helper seam): the per-device
+    sequence block must tile the TPU lane dim; head-dim/dtype/backend
+    rules are the shared _kernel_eligible policy. t_local = T / ring_size."""
+    return _kernel_eligible(D, dtype) and t_local % 128 == 0 and t_local > 0
 
 
 # --------------------------------------------------------------- custom vjp

@@ -99,22 +99,160 @@ def _ring_body(q, k0, v0, axis, n, causal, scale, t_local):
     return acc / jnp.maximum(l, 1e-20)[..., None]
 
 
+# ------------------------------------------------------------- fused ring
+# The XLA ring body above materializes the local [Tq,Tk] score block in HBM
+# every hop; the fused ring folds each hop through the carry-emitting Pallas
+# kernel (ops/pallas_attention.flash_block_update) so per-hop HBM traffic is
+# O(t_local * D). With EQUAL per-device blocks the causal relation between
+# the resident q block and the visiting k/v block is one of exactly three
+# cases — fully visible (src < idx), diagonal (src == idx), fully hidden
+# (src > idx) — so a lax.switch over non-causal / causal / skip kernels
+# covers causality with no global-offset plumbing inside the kernel.
+# Backward is the standard ring-attention decomposition: FlashAttention-2
+# per-hop contributions with the GLOBAL logsumexp, dk/dv accumulators
+# rotating WITH their k/v blocks (after n hops they land back home).
+
+
+def _ring_fused_fwd(q3, k3, v3, axis, n, causal, scale):
+    from ..ops.pallas_attention import flash_block_update
+    idx = jax.lax.axis_index(axis)
+    BH, t, D = q3.shape
+    f32 = jnp.float32
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, j):
+        acc, m, l, k, v = carry
+        src = (idx - j) % n
+        ops = (acc, m, l)
+
+        def diag(o):
+            return flash_block_update(*o, q3, k, v, causal=True, scale=scale)
+
+        def full(o):
+            return flash_block_update(*o, q3, k, v, causal=False, scale=scale)
+
+        def skip(o):
+            return o
+
+        if causal:
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            acc, m, l = jax.lax.switch(branch, [diag, full, skip], ops)
+        else:
+            acc, m, l = full(ops)
+        k = jax.lax.ppermute(k, axis, perm)
+        v = jax.lax.ppermute(v, axis, perm)
+        return (acc, m, l, k, v), None
+
+    acc = jnp.zeros((BH, t, D), f32)
+    m = jnp.full((BH, t, 128), -1e30, f32)
+    l = jnp.zeros((BH, t, 128), f32)
+    (acc, m, l, _, _), _ = jax.lax.scan(step, (acc, m, l, k3, v3),
+                                        jnp.arange(n))
+    o3 = (acc / l[:, :, :1]).astype(q3.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return o3, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_fused(q3, k3, v3, axis, n, causal, scale):
+    o3, _ = _ring_fused_fwd(q3, k3, v3, axis, n, causal, scale)
+    return o3
+
+
+def _ring_fused_fwd_rule(q3, k3, v3, axis, n, causal, scale):
+    o3, lse = _ring_fused_fwd(q3, k3, v3, axis, n, causal, scale)
+    return o3, (q3, k3, v3, o3, lse)
+
+
+def _ring_fused_bwd_rule(axis, n, causal, scale, res, do3):
+    from ..ops.pallas_attention import flash_block_bwd
+    q3, k3, v3, o3, lse = res
+    idx = jax.lax.axis_index(axis)
+    f32 = jnp.float32
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    zero = (jnp.zeros(q3.shape, f32),) + 2 * (jnp.zeros(k3.shape, f32),)
+
+    def step(carry, j):
+        dq, dk, dv, k, v = carry
+        src = (idx - j) % n
+
+        def diag(ops):
+            out = flash_block_bwd(q3, *ops, o3, lse, do3, causal=True,
+                                  scale=scale)
+            return tuple(x.astype(f32) for x in out)
+
+        def full(ops):
+            out = flash_block_bwd(q3, *ops, o3, lse, do3, causal=False,
+                                  scale=scale)
+            return tuple(x.astype(f32) for x in out)
+
+        def skip(ops):
+            return zero
+
+        if causal:
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            dq_c, dk_c, dv_c = jax.lax.switch(branch, [diag, full, skip],
+                                              (k, v))
+        else:
+            dq_c, dk_c, dv_c = full((k, v))
+        dq = dq + dq_c
+        dk = dk + dk_c
+        dv = dv + dv_c
+        # dk/dv accumulators travel WITH their k/v blocks: after n hops
+        # each lands on the device that owns its block
+        k, v, dk, dv = (jax.lax.ppermute(x, axis, perm)
+                        for x in (k, v, dk, dv))
+        return (dq, dk, dv, k, v), None
+
+    dq = jnp.zeros(q3.shape, f32)
+    dk = jnp.zeros(k3.shape, f32)
+    dv = jnp.zeros(v3.shape, f32)
+    (dq, dk, dv, _, _), _ = jax.lax.scan(step, (dq, dk, dv, k3, v3),
+                                         jnp.arange(n))
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype))
+
+
+_ring_fused.defvjp(_ring_fused_fwd_rule, _ring_fused_bwd_rule)
+
+
+def _ring_body_fused(q, k0, v0, axis, n, causal, scale):
+    B, H, t, D = q.shape
+    o3 = _ring_fused(q.reshape(B * H, t, D), k0.reshape(B * H, t, D),
+                     v0.reshape(B * H, t, D), axis, n, causal, scale)
+    return o3.reshape(B, H, t, D)
+
+
 def ring_attention_sharded(mesh: Mesh, axis: str = "seq", *,
                            causal: bool = False,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           use_fused: Optional[bool] = None):
     """Build a jitted ring-attention fn over ``mesh``: inputs [B,H,T,D] with
     T sharded on ``axis`` (T must divide evenly); output sharded the same.
 
         fn = ring_attention_sharded(mesh, "seq", causal=True)
         out = fn(q, k, v)     # q,k,v sharded NamedSharding(mesh, P(None,None,"seq"))
+
+    ``use_fused``: None (default) probes fused_ring_applicable and takes
+    the Pallas carry-emitting hop kernels when the local block qualifies
+    (O(t_local*D) HBM traffic per hop instead of the XLA body's [Tq,Tk]
+    score materialization); True forces, False opts out.
     """
+    from ..ops.pallas_attention import fused_ring_applicable
     n = int(mesh.shape[axis])
 
     def fn(q, k, v):
         sc = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
         t_local = q.shape[2] // n
-        body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
-                                 scale=sc, t_local=t_local)
+        fused = use_fused
+        if fused is None:
+            fused = fused_ring_applicable(t_local, q.shape[-1], q.dtype)
+        if fused:
+            body = functools.partial(_ring_body_fused, axis=axis, n=n,
+                                     causal=causal, scale=sc)
+        else:
+            body = functools.partial(_ring_body, axis=axis, n=n,
+                                     causal=causal, scale=sc,
+                                     t_local=t_local)
         spec = P(None, None, axis, None)
         sharded = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=False)

@@ -41,10 +41,12 @@ def test_encode_capacity_caps_payload():
     assert int(payload.count) <= 10
     update = threshold_decode(payload, 1e-4, 100, g.dtype)
     assert int(jnp.sum(update != 0)) <= 10
-    # the 10 sent entries are the largest-magnitude ones
-    sent_idx = set(np.asarray(payload.indices).tolist())
-    top10 = set(np.argsort(-np.abs(np.asarray(g)))[:10].tolist())
-    assert sent_idx == top10
+    # compaction semantics: the 10 sent entries are the FIRST 10 above
+    # threshold in index order (reference EncodingHandler has no magnitude
+    # ordering; overflow stays in the residual and ships next round)
+    sent_idx = np.asarray(payload.indices).tolist()
+    first10 = np.where(np.abs(np.asarray(g)) >= 1e-4)[0][:10].tolist()
+    assert sent_idx == first10
 
 
 def test_residual_feedback_retransmits_small_values():

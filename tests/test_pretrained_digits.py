@@ -1,0 +1,55 @@
+"""Genuinely-pretrained zoo weights (models.digits_cnn): the committed
+artifact carries weights TRAINED on real handwritten-digit scans
+(tools/train_pretrained_digits.py — UCI optical digits via scikit-learn,
+1,397 train / 400 held out). These tests restore WITHOUT any training and
+verify real generalization, the reference ZooModel.initPretrained
+contract (zoo/ZooModel.java:40-81) with real learned weights behind it."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import digits_cnn
+from deeplearning4j_tpu.models.lenet import (DIGITS_CNN_ARTIFACT,
+                                             DIGITS_CNN_CHECKSUM)
+from deeplearning4j_tpu.models.pretrained import adler32_of
+
+
+def _held_out():
+    """The exact held-out split the training tool never touched."""
+    from sklearn.datasets import load_digits
+    digits = load_digits()
+    x = (digits.images / 16.0).astype(np.float32)[..., None]
+    y = digits.target
+    order = np.random.default_rng(0).permutation(len(x))
+    return x[order][:400], y[order][:400]
+
+
+def test_artifact_checksum_pinned():
+    assert adler32_of(DIGITS_CNN_ARTIFACT) == DIGITS_CNN_CHECKSUM
+
+
+def test_pretrained_restores_and_generalizes():
+    """No fit() anywhere: restored weights alone must classify real
+    held-out scans far above the 10% chance floor."""
+    net = digits_cnn(pretrained=True)
+    x_te, y_te = _held_out()
+    pred = np.argmax(np.asarray(net.output(x_te)), axis=1)
+    acc = float(np.mean(pred == y_te))
+    assert acc >= 0.95, f"pretrained held-out accuracy {acc:.4f}"
+
+
+def test_pretrained_checksum_mismatch_raises(tmp_path):
+    with pytest.raises(IOError, match="Checksum mismatch"):
+        from deeplearning4j_tpu.models.pretrained import init_pretrained
+        net = digits_cnn().init()
+        init_pretrained(net, DIGITS_CNN_ARTIFACT, checksum=12345,
+                        cache_dir=str(tmp_path))
+
+
+def test_fresh_net_is_at_chance():
+    """Control: an untrained digits_cnn scores near chance on the same
+    batch — the accuracy above really comes from the restored weights."""
+    net = digits_cnn(seed=123).init()
+    x_te, y_te = _held_out()
+    pred = np.argmax(np.asarray(net.output(x_te)), axis=1)
+    acc = float(np.mean(pred == y_te))
+    assert acc < 0.5

@@ -1,0 +1,57 @@
+"""Train the committed pretrained artifact for models.digits_cnn.
+
+The reference zoo ships genuinely-trained weights with pinned checksums
+(zoo/ZooModel.java:40-52, trainedmodels/TrainedModels.java VGG16). This rig
+has no egress, so the honest equivalent is trained HERE on real data: the
+UCI optical digits bundled with scikit-learn — 1,797 genuine 8x8 scans of
+handwritten digits. The split is deterministic (seed 0 permutation, first
+400 held out, same as tests/test_lenet_mnist.py's real-digits leg); the
+held-out set is never touched during training, so the restore test's
+accuracy is real generalization, not memorization.
+
+Run from the repo root:  python tools/train_pretrained_digits.py
+Then update DIGITS_CNN_CHECKSUM in deeplearning4j_tpu/models/lenet.py with
+the printed value.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sklearn.datasets import load_digits
+
+from deeplearning4j_tpu.models.lenet import digits_cnn, DIGITS_CNN_ARTIFACT
+from deeplearning4j_tpu.models.pretrained import adler32_of
+from deeplearning4j_tpu.util.serialization import write_model
+
+
+def main():
+    digits = load_digits()
+    x = (digits.images / 16.0).astype(np.float32)[..., None]
+    y = np.eye(10, dtype=np.float32)[digits.target]
+    order = np.random.default_rng(0).permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = 400
+    x_tr, y_tr = x[n_test:], y[n_test:]
+    x_te, y_te = x[:n_test], y[:n_test]
+
+    net = digits_cnn(seed=7).init()
+    net.fit(x_tr, y_tr, epochs=40, batch_size=128)
+    acc_tr = net.evaluate(x_tr, y_tr).accuracy()
+    acc_te = net.evaluate(x_te, y_te).accuracy()
+    print(f"train acc {acc_tr:.4f}  held-out acc {acc_te:.4f}")
+    assert acc_te >= 0.95, "refusing to ship a weak artifact"
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning4j_tpu", "models",
+        "artifacts", "digits_cnn.zip")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    write_model(net, out, save_updater=False)
+    print(f"wrote {out}")
+    print(f"DIGITS_CNN_CHECKSUM = {adler32_of(out)}")
+
+
+if __name__ == "__main__":
+    main()

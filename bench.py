@@ -533,7 +533,10 @@ def bench_piped(batch=128):
 
         n, carry = run_epoch(carry)   # warmup epoch: compile + page cache
         best = float("inf")
-        for _ in range(REPEATS):
+        # two timed epochs, not REPEATS: each costs ~12 tunnel transfers
+        # at 300-420ms, and the piped row exists to measure the feed path,
+        # not to win a best-of lottery
+        for _ in range(min(REPEATS, 2)):
             t0 = time.perf_counter()
             n, carry = run_epoch(carry)
             best = min(best, time.perf_counter() - t0)
@@ -1109,8 +1112,29 @@ class _RowTimeout(Exception):
     """Raised by SIGALRM when a row exceeds its per-row wall-clock cap."""
 
 
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache: distinct-program compiles are the
+    dominant wall-clock cost of this bench (~60-90s each through the
+    tunnel, ~1000s of a cold 1560s run). Cached executables survive across
+    processes, so a re-run — including the driver's official run after a
+    local rehearsal on the same box — spends its budget measuring instead
+    of compiling. BENCH_CACHE_DIR overrides the location; =0 disables."""
+    cache = os.environ.get("BENCH_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    if cache == "0":
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:  # pragma: no cover - version-dependent
+        print(f"[bench] compilation cache unavailable: {e}", file=sys.stderr)
+
+
 def main():
     t_main = time.perf_counter()
+    _enable_compilation_cache()
     # TOTAL wall-clock budget, warmup and core rows INCLUDED (r4's budget
     # gated only the extras loop; the unbudgeted core rows alone outran
     # the driver's timeout). Incremental emission makes an overrun

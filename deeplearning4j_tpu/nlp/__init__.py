@@ -6,6 +6,7 @@ from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
 from .sequence_vectors import SequenceVectors
 from .serde import (read_binary_word_vectors, read_word_vectors,
                     write_binary_word_vectors, write_word_vectors)
+from .lemmatizer import LemmatizingTokenizerFactory, RuleBasedLemmatizer
 from .pos import PosFilterTokenizerFactory, RuleBasedPosTagger
 from .segmentation import (ChineseSegmenter, JapaneseSegmenter,
                            LatticeSegmenter)

@@ -16,6 +16,7 @@ import math
 import threading
 from typing import List, Optional
 
+from . import i18n
 from .storage import StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -32,12 +33,13 @@ _PAGE = """<!DOCTYPE html>
  svg text {{ font-size: 10px; fill: #666; }}
  .meta {{ color: #666; font-size: 12px; }}
 </style></head><body>
-<h1>Training overview <span class="meta">session {session} · worker {worker}</span></h1>
-<div class="card"><h2>Model</h2>{static_table}</div>
-<div class="card"><h2>Score vs. iteration</h2>{score_chart}</div>
-<div class="card"><h2>Throughput (iterations/sec)</h2>{speed_chart}</div>
-<div class="card"><h2>Mean magnitudes: parameters</h2>{param_chart}</div>
-<div class="card"><h2>Update : parameter ratio (log10)</h2>{ratio_chart}</div>
+<h1>{t_pagetitle} <span class="meta">{t_session} {session} · {t_worker} {worker}</span></h1>
+{nav}
+<div class="card"><h2>{t_model}</h2>{static_table}</div>
+<div class="card"><h2>{t_score}</h2>{score_chart}</div>
+<div class="card"><h2>{t_throughput}</h2>{speed_chart}</div>
+<div class="card"><h2>{t_parammag}</h2>{param_chart}</div>
+<div class="card"><h2>{t_ratio}</h2>{ratio_chart}</div>
 {hist_cards}
 {activation_cards}
 {graph_card}
@@ -81,7 +83,15 @@ def _svg_histogram(hist: dict, width=340, height=120):
 
 def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,
                           worker_id: Optional[str] = None,
-                          auto_refresh_sec: int = 0) -> str:
+                          auto_refresh_sec: int = 0,
+                          lang: Optional[str] = None) -> str:
+    """One overview page. Multi-session: a nav bar links every session id
+    (and each session's workers) via ?session=&worker=; ``lang`` renders
+    all chrome through ui/i18n (reference TrainModule.java:94-110 serves
+    the same via DefaultI18N + per-language resources)."""
+    def m(key):
+        return i18n.get_message(key, lang)
+
     sessions = storage.list_session_ids()
     if session_id is None:
         session_id = sessions[-1] if sessions else ""
@@ -128,7 +138,7 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
                 cells.append(f"<div style='display:inline-block;margin:4px'>"
                              f"<div class='meta'>{n}</div>"
                              f"{_svg_histogram(d['histogram'])}</div>")
-        hist_cards = ("<div class='card'><h2>Parameter histograms "
+        hist_cards = (f"<div class='card'><h2>{m('train.histograms')} "
                       f"(iteration {last_with_hist['iteration']})</h2>"
                       + "".join(cells) + "</div>")
 
@@ -145,7 +155,7 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
             f"style='image-rendering:pixelated;border:1px solid #ddd'/></div>"
             for n, b64 in last_with_acts["conv_activations"].items())
         activation_cards = (
-            "<div class='card'><h2>Convolutional activations (iteration "
+            f"<div class='card'><h2>{m('train.activations')} (iteration "
             f"{last_with_acts['iteration']})</h2>{cells}</div>")
 
     # model-graph view (reference FlowIterationListener / TrainModule model
@@ -157,18 +167,57 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
             from ..nn.conf import serde
             from .visual import render_model_graph_svg
             svg = render_model_graph_svg(serde.from_json(cfg_json))
-            graph_card = ("<div class='card'><h2>Model graph</h2>"
+            graph_card = (f"<div class='card'><h2>{m('train.graph')}</h2>"
                           f"<div style='overflow-x:auto'>{svg}</div></div>")
         except (KeyError, ValueError, TypeError) as e:
-            graph_card = (f"<div class='card'><h2>Model graph</h2>"
+            graph_card = (f"<div class='card'><h2>{m('train.graph')}</h2>"
                           f"<p class='meta'>unrenderable: "
                           f"{html.escape(str(e))}</p></div>")
 
     refresh = (f'<meta http-equiv="refresh" content="{auto_refresh_sec}">'
                if auto_refresh_sec else "")
+
+    # multi-session nav: every session (workers of the current one) plus a
+    # language switcher — the TrainModule session-selection capability
+    from urllib.parse import urlencode
+
+    def _link(label, q, current):
+        style = "font-weight:bold" if current else ""
+        return (f"<a style='{style}' href='?{urlencode(q)}'>"
+                f"{html.escape(str(label))}</a>")
+
+    def _q(sid, wid=None, lg=None):
+        q = {"session": sid}
+        if wid:
+            q["worker"] = wid
+        if lg or lang:
+            q["lang"] = lg or lang
+        return q
+
+    nav = ""
+    if sessions:
+        sess_links = " · ".join(
+            _link(s_, _q(s_), s_ == session_id) for s_ in sessions)
+        worker_links = " · ".join(
+            _link(w, _q(session_id, w), w == worker_id) for w in workers)
+        lang_links = " · ".join(
+            _link(lg, _q(session_id, worker_id, lg), lg == (lang or "en"))
+            for lg in i18n.languages())
+        nav = (f"<div class='card meta'><b>{m('train.sessions')}:</b> "
+               f"{sess_links}"
+               + (f" &nbsp;|&nbsp; <b>{m('train.worker')}:</b> {worker_links}"
+                  if len(workers) > 1 else "")
+               + f" &nbsp;|&nbsp; <b>{m('train.language')}:</b> {lang_links}"
+               "</div>")
+
     return _PAGE.format(
         refresh=refresh, session=html.escape(session_id or "–", quote=True),
         worker=html.escape(worker_id or "–", quote=True),
+        nav=nav,
+        t_pagetitle=m("train.pagetitle"), t_session=m("train.session"),
+        t_worker=m("train.worker"), t_model=m("train.model"),
+        t_score=m("train.score"), t_throughput=m("train.throughput"),
+        t_parammag=m("train.parammag"), t_ratio=m("train.ratio"),
         static_table=static_table,
         score_chart=_svg_line_chart([("score", score_pts)]),
         speed_chart=_svg_line_chart([("it/s", speed_pts)]),
@@ -235,9 +284,10 @@ class TrainingUIServer:
                     q = parse_qs(urlparse(self.path).query)
                     sid = q.get("session", [None])[0]
                     wid = q.get("worker", [None])[0]
+                    lng = q.get("lang", [None])[0]
                     body = render_dashboard_html(
                         server._storages[-1], sid, wid,
-                        auto_refresh_sec=5).encode()
+                        auto_refresh_sec=5, lang=lng).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/html; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))

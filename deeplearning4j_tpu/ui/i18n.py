@@ -1,0 +1,140 @@
+"""Train-UI internationalization.
+
+Reference: deeplearning4j-ui-parent/deeplearning4j-play
+.../i18n/DefaultI18N.java + the per-language ``dl4j_i18n/*.properties``
+resources that TrainModule serves (TrainModule.java:94-110 renders every
+page element through I18N.getMessage). Same contract here: a key/value
+message table per language, English fallback for missing keys, and a
+process-wide default language the dashboard uses when the request doesn't
+pick one (``?lang=``).
+
+The reference ships en/de/ja/ko/ru/zh; the same six are provided for every
+string the dashboard renders.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+_EN = {
+    "train.pagetitle": "Training overview",
+    "train.session": "session",
+    "train.worker": "worker",
+    "train.sessions": "Sessions",
+    "train.language": "Language",
+    "train.model": "Model",
+    "train.score": "Score vs. iteration",
+    "train.throughput": "Throughput (iterations/sec)",
+    "train.parammag": "Mean magnitudes: parameters",
+    "train.ratio": "Update : parameter ratio (log10)",
+    "train.histograms": "Parameter histograms",
+    "train.activations": "Convolutional activations",
+    "train.graph": "Model graph",
+    "train.nodata": "no data yet",
+}
+
+_MESSAGES: Dict[str, Dict[str, str]] = {
+    "en": _EN,
+    "de": {
+        "train.pagetitle": "Trainingsübersicht",
+        "train.session": "Sitzung",
+        "train.worker": "Worker",
+        "train.sessions": "Sitzungen",
+        "train.language": "Sprache",
+        "train.model": "Modell",
+        "train.score": "Score pro Iteration",
+        "train.throughput": "Durchsatz (Iterationen/Sek.)",
+        "train.parammag": "Mittlere Beträge: Parameter",
+        "train.ratio": "Update-zu-Parameter-Verhältnis (log10)",
+        "train.histograms": "Parameter-Histogramme",
+        "train.activations": "Konvolutions-Aktivierungen",
+        "train.graph": "Modellgraph",
+        "train.nodata": "noch keine Daten",
+    },
+    "ja": {
+        "train.pagetitle": "トレーニング概要",
+        "train.session": "セッション",
+        "train.worker": "ワーカー",
+        "train.sessions": "セッション一覧",
+        "train.language": "言語",
+        "train.model": "モデル",
+        "train.score": "スコア対イテレーション",
+        "train.throughput": "スループット（イテレーション/秒）",
+        "train.parammag": "パラメータの平均絶対値",
+        "train.ratio": "更新とパラメータの比率 (log10)",
+        "train.histograms": "パラメータのヒストグラム",
+        "train.activations": "畳み込み活性化",
+        "train.graph": "モデルグラフ",
+        "train.nodata": "データなし",
+    },
+    "ko": {
+        "train.pagetitle": "훈련 개요",
+        "train.session": "세션",
+        "train.worker": "워커",
+        "train.sessions": "세션 목록",
+        "train.language": "언어",
+        "train.model": "모델",
+        "train.score": "반복별 스코어",
+        "train.throughput": "처리량 (반복/초)",
+        "train.parammag": "파라미터 평균 크기",
+        "train.ratio": "업데이트 대 파라미터 비율 (log10)",
+        "train.histograms": "파라미터 히스토그램",
+        "train.activations": "합성곱 활성화",
+        "train.graph": "모델 그래프",
+        "train.nodata": "데이터 없음",
+    },
+    "ru": {
+        "train.pagetitle": "Обзор обучения",
+        "train.session": "сессия",
+        "train.worker": "воркер",
+        "train.sessions": "Сессии",
+        "train.language": "Язык",
+        "train.model": "Модель",
+        "train.score": "Ошибка по итерациям",
+        "train.throughput": "Производительность (итераций/с)",
+        "train.parammag": "Средние модули: параметры",
+        "train.ratio": "Отношение обновления к параметру (log10)",
+        "train.histograms": "Гистограммы параметров",
+        "train.activations": "Свёрточные активации",
+        "train.graph": "Граф модели",
+        "train.nodata": "данных пока нет",
+    },
+    "zh": {
+        "train.pagetitle": "训练概览",
+        "train.session": "会话",
+        "train.worker": "工作节点",
+        "train.sessions": "会话列表",
+        "train.language": "语言",
+        "train.model": "模型",
+        "train.score": "得分随迭代变化",
+        "train.throughput": "吞吐量（迭代/秒）",
+        "train.parammag": "参数平均幅值",
+        "train.ratio": "更新与参数比值 (log10)",
+        "train.histograms": "参数直方图",
+        "train.activations": "卷积激活",
+        "train.graph": "模型图",
+        "train.nodata": "暂无数据",
+    },
+}
+
+_DEFAULT = "en"
+
+
+def languages():
+    """Supported language codes (the reference's six)."""
+    return sorted(_MESSAGES)
+
+
+def set_default_language(lang: str):
+    """DefaultI18N.setDefaultLanguage equivalent."""
+    global _DEFAULT
+    if lang not in _MESSAGES:
+        raise ValueError(f"unsupported language {lang!r}; "
+                         f"available: {languages()}")
+    _DEFAULT = lang
+
+
+def get_message(key: str, lang: str = None) -> str:
+    """DefaultI18N.getMessage: requested language, English fallback, key
+    itself as the last resort (the reference renders the raw key too)."""
+    table = _MESSAGES.get(lang or _DEFAULT, _EN)
+    return table.get(key) or _EN.get(key) or key

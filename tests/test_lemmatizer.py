@@ -1,0 +1,77 @@
+"""Rule lemmatizer (nlp/lemmatizer.py) — the UIMA lemma seam
+(PosUimaTokenizer.java:76-77) without analysis-engine downloads."""
+import pytest
+
+from deeplearning4j_tpu.nlp import (LemmatizingTokenizerFactory,
+                                    RuleBasedLemmatizer)
+from deeplearning4j_tpu.nlp.tokenizer import (CommonPreprocessor,
+                                              DefaultTokenizerFactory)
+
+
+@pytest.mark.parametrize("word,lemma", [
+    ("running", "run"), ("makes", "make"), ("driving", "drive"),
+    ("tried", "try"), ("wanted", "want"), ("stopped", "stop"),
+    ("cities", "city"), ("dogs", "dog"), ("boxes", "box"),
+    ("churches", "church"), ("heroes", "hero"), ("leaves", "leaf"),
+    ("was", "be"), ("is", "be"), ("been", "be"), ("has", "have"),
+    ("went", "go"), ("taken", "take"), ("children", "child"),
+    ("women", "woman"), ("wrote", "write"), ("bigger", "big"),
+    ("best", "good"), ("earliest", "early"),
+    # must NOT be mangled
+    ("this", "this"), ("news", "news"), ("glass", "glass"),
+    ("series", "series"), ("run", "run"), ("red", "red"),
+])
+def test_lemma_cases(word, lemma):
+    assert RuleBasedLemmatizer().lemmatize(word) == lemma
+
+
+def test_factory_wraps_any_tokenizer():
+    f = LemmatizingTokenizerFactory(DefaultTokenizerFactory())
+    toks = f.create("the children were running and the dogs barked").get_tokens()
+    assert toks == ["the", "child", "be", "run", "and", "the", "dog", "bark"]
+
+
+def test_factory_composes_with_preprocessor():
+    f = LemmatizingTokenizerFactory(DefaultTokenizerFactory())
+    f.set_token_pre_processor(CommonPreprocessor())
+    toks = f.create("Dogs, running!").get_tokens()
+    assert "dog" in toks and "run" in toks
+
+
+def test_vocab_folding_shrinks_vocabulary():
+    """The use case the reference's lemma path serves: inflected variants
+    fold into one vocabulary entry for embedding training."""
+    text = ("the dog runs . the dogs ran . a dog is running . "
+            "dogs have run .")
+    base = DefaultTokenizerFactory()
+    lem = LemmatizingTokenizerFactory(base)
+    v_base = set(base.create(text).get_tokens())
+    v_lem = set(lem.create(text).get_tokens())
+    assert {"dog", "run"} <= v_lem
+    assert not {"dogs", "running", "ran"} & v_lem
+    assert len(v_lem) < len(v_base)
+
+
+@pytest.mark.parametrize("word,lemma", [
+    # multi-syllable regular verbs must NOT grow an invented trailing e
+    ("opened", "open"), ("happened", "happen"), ("visited", "visit"),
+    ("listened", "listen"), ("covered", "cover"), ("opening", "open"),
+    # stems that really dropped an e still restore it
+    ("believed", "believe"), ("received", "receive"), ("danced", "dance"),
+    ("argued", "argue"), ("loved", "love"),
+])
+def test_restore_e_multisyllable(word, lemma):
+    assert RuleBasedLemmatizer().lemmatize(word) == lemma
+
+
+def test_pos_disambiguates_irregular_forms():
+    """The caller's Penn tag picks the reading: 'lives' is the verb
+    'live' as VBZ but the noun 'life' as NNS."""
+    L = RuleBasedLemmatizer()
+    assert L.lemmatize("lives", "VBZ") == "live"
+    assert L.lemmatize("lives", "NNS") == "life"
+    assert L.lemmatize("leaves", "VBZ") == "leave"
+    assert L.lemmatize("leaves", "NNS") == "leaf"
+    # a mis-tagged unambiguous irregular still folds
+    assert L.lemmatize("children", "VB") == "child"
+    assert L.lemmatize("went", "NN") == "go"

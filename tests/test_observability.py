@@ -285,3 +285,41 @@ def test_sqlite_storage_with_stats_listener(tmp_path):
     ups = store.get_updates("t", "w")
     assert len(ups) == 3 and all("score" in u for u in ups)
     store.close()
+
+
+def test_dashboard_i18n_and_multisession():
+    """TrainModule parity depth (reference TrainModule.java:94-110 +
+    DefaultI18N): the page renders in each of the reference's six
+    languages and links every attached session."""
+    from deeplearning4j_tpu.ui import i18n
+    from deeplearning4j_tpu.ui.dashboard import render_dashboard_html
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    store = InMemoryStatsStorage()
+    for sid in ("sessA", "sessB"):
+        store.put_static_info(sid, "w0", {"model": "mlp"})
+        store.put_update(sid, "w0", {"iteration": 1, "score": 1.5})
+    # multi-session nav: both sessions linked regardless of which renders
+    page = render_dashboard_html(store, "sessA")
+    assert "session=sessA" in page and "session=sessB" in page
+    # i18n: all six reference languages render their own page title
+    assert sorted(i18n.languages()) == ["de", "en", "ja", "ko", "ru", "zh"]
+    for lang in i18n.languages():
+        p = render_dashboard_html(store, "sessA", lang=lang)
+        assert i18n.get_message("train.pagetitle", lang) in p
+    # unknown keys and fallback
+    assert i18n.get_message("train.model", "ja") == "モデル"
+    assert i18n.get_message("no.such.key", "ja") == "no.such.key"
+    # ?lang= routing through the live server
+    import urllib.request
+    from deeplearning4j_tpu.ui.dashboard import TrainingUIServer
+    srv = TrainingUIServer(port=0)
+    srv.attach(store)
+    port = srv.start()
+    try:
+        html_ja = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/?session=sessA&lang=ja",
+            timeout=10).read().decode()
+        assert "トレーニング概要" in html_ja
+    finally:
+        srv.stop()

@@ -154,11 +154,112 @@ def test_lenet_restore_and_predict_parity():
 
 def test_unsupported_layer_is_a_clear_error(tmp_path):
     import json
-    conf = {"confs": [{"layer": {"gravesLSTM": {"activationFunction": "tanh",
-                                                "nin": 3, "nout": 4}}}]}
+    conf = {"confs": [{"layer": {"RBM": {"activationFunction": "sigmoid",
+                                         "nin": 3, "nout": 4}}}]}
     p = tmp_path / "bad.zip"
     with zipfile.ZipFile(p, "w") as z:
         z.writestr("configuration.json", json.dumps(conf))
         z.writestr("coefficients.bin", b"")
     with pytest.raises(ValueError, match="unsupported DL4J layer"):
         import_dl4j_zip(str(p))
+
+
+# ------------------------------------------------------ GravesLSTM fixture
+def _numpy_graves_lstm(x):
+    """Independent DL4J-semantics LSTM forward, straight from the JAVA
+    layout (LSTMHelpers.java): gate columns (g, f, o, i) — block input
+    first, "input modulation gate" last — and peephole columns
+    (wFF, wOO, wGG) = (forget, output, input-gate). No shared code with
+    the importer's gate permutation."""
+    w = np.load(os.path.join(FIX, "graves_raw_weights.npy"),
+                allow_pickle=True).item()
+    W, RW, b, oW, ob = w["W"], w["RW"], w["b"], w["oW"], w["ob"]
+    B, T, nin = x.shape
+    h = RW.shape[0]
+    sig = lambda z: 1.0 / (1.0 + np.exp(-z))
+    R4 = RW[:, :4 * h]
+    wFF, wOO, wGG = RW[:, 4 * h], RW[:, 4 * h + 1], RW[:, 4 * h + 2]
+    hs = np.zeros((B, T, h), np.float32)
+    hp = np.zeros((B, h), np.float32)
+    cp = np.zeros((B, h), np.float32)
+    for t in range(T):
+        z = x[:, t] @ W + hp @ R4 + b            # [B, 4H], (g,f,o,i)
+        zg, zf, zo, zi = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+        f = sig(zf + cp * wFF)
+        i = sig(zi + cp * wGG)
+        g = np.tanh(zg)
+        c = f * cp + i * g
+        o = sig(zo + c * wOO)
+        hp = o * np.tanh(c)
+        cp = c
+        hs[:, t] = hp
+    z = hs @ oW + ob
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_graves_lstm_restore_and_predict_parity():
+    """The reference's flagship recurrent layer crosses the gate-order
+    (g,f,o,i)->(i,f,o,g) and peephole-column boundaries; parity against a
+    from-the-Java-layout numpy forward proves both mappings."""
+    net = import_dl4j_zip(os.path.join(FIX, "080_graves_char_rnn.zip"))
+    from deeplearning4j_tpu.nn.layers import GravesLSTM
+    assert type(net.conf.layers[0]) is GravesLSTM
+    x = np.random.default_rng(2).normal(size=(3, 6, 5)).astype(np.float32)
+    ours = np.asarray(net.output(x))
+    expect = _numpy_graves_lstm(x)
+    np.testing.assert_allclose(ours, expect, atol=2e-4)
+
+
+def test_lstm_updater_state_lands_on_correct_leaves(tmp_path):
+    """Regression (r5 review): jax.tree.flatten SORTS dict keys, so the
+    updater-state blocks must be ordered by sorted param name. With
+    nIn == nOut every shape coincides and a wrong order would pass the
+    shape guard silently — pin each momentum buffer to its param."""
+    import json
+    import jax
+    from deeplearning4j_tpu.interop.dl4j_zip import write_nd4j_array
+
+    nin = h = 4
+    lstm = {"layerName": "l0", "activationFunction": "tanh", "nin": nin,
+            "nout": h, "updater": "NESTEROVS", "learningRate": 0.1,
+            "momentum": 0.9, "l1": 0.0, "l2": 0.0, "dropOut": 0.0}
+    conf = {"backprop": True, "confs": [
+        {"seed": 1, "pretrain": False, "layer": {"gravesLSTM": lstm}}]}
+    n = nin * 4 * h + h * (4 * h + 3) + 4 * h
+    params = np.arange(1, n + 1, dtype=np.float32)
+    upd = np.arange(1001, 1001 + n, dtype=np.float32)
+    p = tmp_path / "lstm.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin",
+                   write_nd4j_array(params.reshape(1, -1), order="c"))
+        z.writestr("updaterState.bin",
+                   write_nd4j_array(upd.reshape(1, -1), order="c"))
+    net = import_dl4j_zip(str(p))
+    assert not net.import_notes, net.import_notes
+
+    # expected layout, computed independently (Java order: W 'f', RW 'f',
+    # b; gate blocks (g,f,o,i) -> ours (i,f,o,g); peepholes (pf,po,pi))
+    def gates(a):
+        return np.concatenate([a[..., 3*h:4*h], a[..., h:2*h],
+                               a[..., 2*h:3*h], a[..., 0:h]], axis=-1)
+
+    def split(flat):
+        W = gates(flat[:nin*4*h].reshape((nin, 4*h), order="F"))
+        RW = flat[nin*4*h:nin*4*h + h*(4*h+3)].reshape((h, 4*h+3), order="F")
+        b = gates(flat[-4*h:])
+        return {"W": W, "R": gates(RW[:, :4*h]), "b": b,
+                "pf": RW[:, 4*h], "po": RW[:, 4*h+1], "pi": RW[:, 4*h+2]}
+
+    want_p = split(params)
+    for name, arr in want_p.items():
+        np.testing.assert_array_equal(np.asarray(net.params[0][name]), arr,
+                                      err_msg=f"param {name}")
+    # momentum tree: leaves are SORTED by param name per layer
+    want_u = split(upd)
+    leaves = [np.asarray(l) for l in jax.tree.leaves(net.opt_state)
+              if np.asarray(l).size > 1]
+    for leaf, name in zip(leaves, sorted(want_u)):
+        np.testing.assert_array_equal(leaf, want_u[name],
+                                      err_msg=f"momentum {name}")

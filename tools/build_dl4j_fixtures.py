@@ -105,6 +105,38 @@ def lenet_fixture(path):
     _write_zip(path, conf, flat.reshape(1, -1), None)
 
 
+def graves_lstm_fixture(path):
+    """GravesLSTM char-RNN (the reference's flagship recurrent demo,
+    GravesLSTMCharModellingExample): gravesLSTM(5->8, tanh) +
+    rnnoutput(8->5, softmax, MCXENT). Weights seeded-random, written in
+    the Java layouts: input W [nIn,4H] 'f', recurrent [H,4H+3] 'f' (the
+    +3 columns are the wFF/wOO/wGG peepholes), bias [4H]; gate column
+    order (g, f, o, i) per LSTMHelpers.java."""
+    nin, h, nout = 5, 8, 5
+    lstm = _base_layer("lstm0", "tanh", nin, h)
+    conf = {
+        "backprop": True, "pretrain": False, "backpropType": "Standard",
+        "confs": [
+            _conf({"gravesLSTM": lstm}),
+            _conf({"rnnoutput": _base_layer("out", "softmax", h, nout,
+                                            lossFunction="MCXENT")}),
+        ],
+        "inputPreProcessors": {},
+    }
+    r = np.random.default_rng(7)
+    W = r.normal(0, 0.3, (nin, 4 * h)).astype(np.float32)
+    RW = r.normal(0, 0.3, (h, 4 * h + 3)).astype(np.float32)
+    b = r.normal(0, 0.1, (4 * h,)).astype(np.float32)
+    oW = r.normal(0, 0.3, (h, nout)).astype(np.float32)
+    ob = r.normal(0, 0.1, (nout,)).astype(np.float32)
+    flat = np.concatenate([W.ravel(order="F"), RW.ravel(order="F"), b,
+                           oW.ravel(order="F"), ob]).astype(np.float32)
+    np.save(os.path.join(OUT, "graves_raw_weights.npy"),
+            {"W": W, "RW": RW, "b": b, "oW": oW, "ob": ob},
+            allow_pickle=True)
+    _write_zip(path, conf, flat.reshape(1, -1), None)
+
+
 def _write_zip(path, conf, params, updater_state):
     with zipfile.ZipFile(path, "w") as z:
         z.writestr("configuration.json", json.dumps(conf))
@@ -119,3 +151,4 @@ if __name__ == "__main__":
     os.makedirs(OUT, exist_ok=True)
     mlp_fixture(os.path.join(OUT, "080_mlp_3_4_5.zip"))
     lenet_fixture(os.path.join(OUT, "080_lenet_flat_8x8.zip"))
+    graves_lstm_fixture(os.path.join(OUT, "080_graves_char_rnn.zip"))

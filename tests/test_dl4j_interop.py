@@ -263,3 +263,49 @@ def test_lstm_updater_state_lands_on_correct_leaves(tmp_path):
     for leaf, name in zip(leaves, sorted(want_u)):
         np.testing.assert_array_equal(leaf, want_u[name],
                                       err_msg=f"momentum {name}")
+
+
+def test_batchnorm_restore_params_and_running_stats(tmp_path):
+    """DL4J stores BN running mean/var as PARAMS in the flat buffer
+    (BatchNormalizationParamInitializer.java:61-84); here they are
+    functional state. Inference parity against the closed-form numpy
+    BN proves gamma/beta land in params and mean/var in state."""
+    import json
+    nf = 6
+    r = np.random.default_rng(4)
+    gamma = r.normal(1, 0.1, nf).astype(np.float32)
+    beta = r.normal(0, 0.1, nf).astype(np.float32)
+    mean = r.normal(0, 1, nf).astype(np.float32)
+    var = r.uniform(0.5, 2.0, nf).astype(np.float32)
+    W = r.normal(0, 0.3, (4, nf)).astype(np.float32)
+    b = r.normal(0, 0.1, nf).astype(np.float32)
+    oW = r.normal(0, 0.3, (nf, 3)).astype(np.float32)
+    ob = r.normal(0, 0.1, 3).astype(np.float32)
+    conf = {"backprop": True, "confs": [
+        {"seed": 1, "pretrain": False, "layer": {"dense": {
+            "activationFunction": "identity", "nin": 4, "nout": nf,
+            "updater": "NESTEROVS", "learningRate": 0.1, "momentum": 0.9}}},
+        {"seed": 1, "pretrain": False, "layer": {"batchNormalization": {
+            "nin": nf, "nout": nf, "decay": 0.9, "eps": 1e-5,
+            "activationFunction": "relu"}}},
+        {"seed": 1, "pretrain": False, "layer": {"output": {
+            "activationFunction": "softmax", "lossFunction": "MCXENT",
+            "nin": nf, "nout": 3}}},
+    ]}
+    flat = np.concatenate([W.ravel(order="F"), b, gamma, beta, mean, var,
+                           oW.ravel(order="F"), ob]).astype(np.float32)
+    p = tmp_path / "bn.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin",
+                   write_nd4j_array(flat.reshape(1, -1), order="c"))
+    net = import_dl4j_zip(str(p))
+    np.testing.assert_array_equal(np.asarray(net.params[1]["gamma"]), gamma)
+    np.testing.assert_array_equal(np.asarray(net.state[1]["mean"]), mean)
+    x = r.normal(size=(5, 4)).astype(np.float32)
+    h = x @ W + b
+    y = np.maximum((h - mean) / np.sqrt(var + 1e-5) * gamma + beta, 0.0)
+    z2 = y @ oW + ob
+    e = np.exp(z2 - z2.max(1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               e / e.sum(1, keepdims=True), atol=1e-5)

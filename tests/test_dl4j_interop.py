@@ -309,3 +309,71 @@ def test_batchnorm_restore_params_and_running_stats(tmp_path):
     e = np.exp(z2 - z2.max(1, keepdims=True))
     np.testing.assert_allclose(np.asarray(net.output(x)),
                                e / e.sum(1, keepdims=True), atol=1e-5)
+
+
+def test_graves_bidirectional_restore_and_predict_parity(tmp_path):
+    """Bidirectional layout = forward (W,RW,b) then backward (W,RW,b),
+    each GravesLSTM-shaped (GravesBidirectionalLSTMParamInitializer
+    .java:98-112); DL4J SUMS the direction outputs. Oracle: run the
+    same numpy Graves cell both ways from the raw Java buffers."""
+    import json
+
+    nin, h, nout = 3, 5, 2
+    r = np.random.default_rng(11)
+
+    def direction():
+        return (r.normal(0, 0.3, (nin, 4 * h)).astype(np.float32),
+                r.normal(0, 0.3, (h, 4 * h + 3)).astype(np.float32),
+                r.normal(0, 0.1, (4 * h,)).astype(np.float32))
+
+    Wf, RWf, bf = direction()
+    Wb, RWb, bb = direction()
+    oW = r.normal(0, 0.3, (h, nout)).astype(np.float32)
+    ob = r.normal(0, 0.1, (nout,)).astype(np.float32)
+    conf = {"backprop": True, "confs": [
+        {"seed": 1, "pretrain": False, "layer": {"gravesBidirectionalLSTM": {
+            "activationFunction": "tanh", "nin": nin, "nout": h,
+            "updater": "SGD", "learningRate": 0.1}}},
+        {"seed": 1, "pretrain": False, "layer": {"rnnoutput": {
+            "activationFunction": "softmax", "lossFunction": "MCXENT",
+            "nin": h, "nout": nout}}}]}
+    flat = np.concatenate([
+        Wf.ravel(order="F"), RWf.ravel(order="F"), bf,
+        Wb.ravel(order="F"), RWb.ravel(order="F"), bb,
+        oW.ravel(order="F"), ob]).astype(np.float32)
+    p = tmp_path / "bi.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin",
+                   write_nd4j_array(flat.reshape(1, -1), order="c"))
+    net = import_dl4j_zip(str(p))
+    from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM
+    assert type(net.conf.layers[0]) is GravesBidirectionalLSTM
+
+    def cell(x, W, RW, b):        # DL4J-layout numpy Graves cell
+        sig = lambda z: 1.0 / (1.0 + np.exp(-z))
+        R4, wFF, wOO, wGG = (RW[:, :4*h], RW[:, 4*h], RW[:, 4*h+1],
+                             RW[:, 4*h+2])
+        B, T = x.shape[:2]
+        hs = np.zeros((B, T, h), np.float32)
+        hp = np.zeros((B, h), np.float32)
+        cp = np.zeros((B, h), np.float32)
+        for t in range(T):
+            z = x[:, t] @ W + hp @ R4 + b
+            zg, zf, zo, zi = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h],
+                              z[:, 3*h:])
+            f = sig(zf + cp * wFF)
+            i = sig(zi + cp * wGG)
+            c = f * cp + i * np.tanh(zg)
+            o = sig(zo + c * wOO)
+            hp, cp = o * np.tanh(c), c
+            hs[:, t] = hp
+        return hs
+
+    x = np.random.default_rng(3).normal(size=(2, 7, nin)).astype(np.float32)
+    fwd = cell(x, Wf, RWf, bf)
+    bwd = cell(x[:, ::-1], Wb, RWb, bb)[:, ::-1]
+    z = (fwd + bwd) @ oW + ob
+    e = np.exp(z - z.max(-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               e / e.sum(-1, keepdims=True), atol=2e-4)

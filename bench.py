@@ -61,10 +61,12 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    with a margin far above noise and (b) a
                                    similarity probe: trained pairs must be
                                    measurably closer than random pairs
-  - attention_long_context         causal self-attention fwd+bwd at T=2048:
-                                   fused Pallas flash kernels vs the XLA
-                                   path (ops/pallas_attention.py), both
-                                   slope-timed, + fused_vs_xla ratio
+  - attention_long_context         causal self-attention fwd+bwd at T=2048,
+                                   D=128 AND D=64 (GPT-2-class head dim,
+                                   new in r5): fused Pallas flash kernels
+                                   vs the XLA path (ops/pallas_attention
+                                   .py), all slope-timed, + fused_vs_xla
+                                   and d64_fused_vs_xla ratios
   - transformer_lm_tokens_per_sec  end-to-end decoder-only LM train step
                                    (12 blocks, d=512, 8 heads -> head dim
                                    64 on the fused flash path, T=1024,
@@ -87,7 +89,7 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    25M-param flat gradient (DCN codec cost)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
-BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1500),
+BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1560),
 BENCH_ROW_CAP_S (per-row SIGALRM cap; default 300), BENCH_PEAK_TFLOPS,
 BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU, BENCH_REPEATS (timed windows per
 bench, best-of; default 3).
@@ -734,19 +736,20 @@ def bench_attention():
     """Long-context attention training step (fwd+bwd through a causal
     self-attention), tokens/sec: the fused Pallas flash kernels
     (ops/pallas_attention.py — O(T) HBM traffic) vs the XLA path that
-    materializes the [B,H,T,T] scores. B=4, H=8, T=2048, D=128.
-    Slope-timed (the step is a few ms — under the tunnel's dispatch
-    floor); same roofline contract as every row."""
+    materializes the [B,H,T,T] scores. B=4, H=8, T=2048 at BOTH D=128
+    (the r3/r4 comparison point) and D=64 (the GPT-2-class head dim the
+    round-5 kernels newly cover — sub-keys d64_fused / d64_xla /
+    d64_fused_vs_xla). Slope-timed
+    (the step is a few ms — under the tunnel's dispatch floor); same
+    roofline contract as every row."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.ops.pallas_attention import (
         flash_attention, fused_attention_applicable)
     from deeplearning4j_tpu.parallel.ring_attention import attention
 
-    B, H, T, D = 4, 8, 2048, 128
+    B, H, T = 4, 8, 2048
     rng = np.random.default_rng(0)
-    qkv = tuple(jnp.asarray(rng.normal(size=(B, H, T, D)) * 0.1, jnp.float32)
-                for _ in range(3))
 
     def make_step(fn):
         def step(xs, carry):
@@ -760,35 +763,46 @@ def bench_attention():
             return q - 1e-9 * dq, k - 1e-9 * dk, v - 1e-9 * dv
         return step
 
-    # ANALYTIC flop counts: XLA's cost analysis cannot see inside Pallas
-    # custom calls (it returns None, which would silently bypass the
-    # roofline guard — the guard needs a flop count to have teeth).
-    # fwd = 4*B*H*T^2*D (QK^T + PV); bwd recomputes s in both passes and
-    # runs 5 more T^2-sized matmuls (dp, dq, dk, dv, p^T@do) ~ 2.5x fwd
-    # => ~14*B*H*T^2*D per train step; the fused causal kernels skip the
-    # upper triangle (~0.5x).
-    full_flops = 14.0 * B * H * T * T * D
-    out = {"config": {"B": B, "H": H, "T": T, "D": D, "causal": True}}
+    out = {"config": {"B": B, "H": H, "T": T, "D": [128, 64],
+                      "causal": True}}
     zero = jnp.zeros((8, 128), jnp.float32)
-    for name, fn in (("fused", flash_attention), ("xla", attention)):
-        if name == "fused" and not fused_attention_applicable(
-                B, H, T, D, jnp.float32):
-            out["fused"] = None
-            continue
-        step = make_step(fn)
-        flops = full_flops * (0.5 if name == "fused" else 1.0)
-        row, dt, _ = _slope_rate(step, zero, qkv,
-                                 items_per_step=B * T, flops=flops,
-                                 label=f"attention_{name}",
-                                 n_pair=(64, 576))
-        out[name] = (row if isinstance(row, dict)
-                     else {"tokens_per_sec": round(row, 1),
-                           "step_ms": round(dt * 1e3, 3)})
-    fu, xl = out.get("fused"), out.get("xla")
-    if (isinstance(fu, dict) and fu.get("tokens_per_sec")
-            and isinstance(xl, dict) and xl.get("tokens_per_sec")):
-        out["fused_vs_xla"] = round(
-            fu["tokens_per_sec"] / xl["tokens_per_sec"], 3)
+    for D in (128, 64):
+      # per-D isolation: a failure in the (newer) D=64 passes must not
+      # discard the already-measured D=128 headline sub-rows
+      try:
+        qkv = tuple(jnp.asarray(rng.normal(size=(B, H, T, D)) * 0.1,
+                                jnp.float32) for _ in range(3))
+        # ANALYTIC flop counts: XLA's cost analysis cannot see inside
+        # Pallas custom calls (it returns None, which would silently
+        # bypass the roofline guard — the guard needs a flop count to
+        # have teeth). fwd = 4*B*H*T^2*D (QK^T + PV); bwd recomputes s in
+        # both passes and runs 5 more T^2-sized matmuls ~ 2.5x fwd
+        # => ~14*B*H*T^2*D per train step; the fused causal kernels skip
+        # the upper triangle (~0.5x).
+        full_flops = 14.0 * B * H * T * T * D
+        sub = "" if D == 128 else "d64_"
+        for name, fn in (("fused", flash_attention), ("xla", attention)):
+            if name == "fused" and not fused_attention_applicable(
+                    B, H, T, D, jnp.float32):
+                out[sub + "fused"] = None
+                continue
+            step = make_step(fn)
+            flops = full_flops * (0.5 if name == "fused" else 1.0)
+            row, dt, _ = _slope_rate(step, zero, qkv,
+                                     items_per_step=B * T, flops=flops,
+                                     label=f"attention_{name}_d{D}",
+                                     n_pair=(64, 576))
+            out[sub + name] = (row if isinstance(row, dict)
+                               else {"tokens_per_sec": round(row, 1),
+                                     "step_ms": round(dt * 1e3, 3)})
+        fu, xl = out.get(sub + "fused"), out.get(sub + "xla")
+        if (isinstance(fu, dict) and fu.get("tokens_per_sec")
+                and isinstance(xl, dict) and xl.get("tokens_per_sec")):
+            out[sub + "fused_vs_xla"] = round(
+                fu["tokens_per_sec"] / xl["tokens_per_sec"], 3)
+      except Exception as e:
+        print(f"attention D={D} sub-rows failed: {e}", file=sys.stderr)
+        out[("" if D == 128 else "d64_") + "error"] = str(e)[:200]
     return out
 
 
@@ -1139,7 +1153,9 @@ def main():
     # gated only the extras loop; the unbudgeted core rows alone outran
     # the driver's timeout). Incremental emission makes an overrun
     # harmless, but the budget keeps late rows from starving.
-    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    # 1560: the r4 driver demonstrably ran >=1586s of stages before its
+    # kill, and per-row emission makes a small overshoot harmless
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1560"))
     row_cap = float(os.environ.get("BENCH_ROW_CAP_S", "300"))
     RESULT["config"] = {"batch": BATCH, "img": IMG, "dtype": "float32"}
     extras = RESULT["extras"]
@@ -1259,10 +1275,12 @@ def main():
             ("attention_long_context", bench_attention),
             ("transformer_lm_tokens_per_sec", _tlm_ours),
             ("transformer_lm_flax_tokens_per_sec", _tlm_flax),
-            ("resnet50_amp_img_per_sec", _amp_ours),
-            ("resnet50_piped_img_per_sec", _piped),
+            # cheap rows before the expendable ones: if the budget gates,
+            # AMP/piped are the sacrificed tail, not the DCN codec row
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overhead_by_mesh", bench_collective_overhead),
+            ("resnet50_amp_img_per_sec", _amp_ours),
+            ("resnet50_piped_img_per_sec", _piped),
         ]
 
     for name, fn in rows:

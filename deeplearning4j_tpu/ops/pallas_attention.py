@@ -19,7 +19,10 @@ Design (same helper-probe-with-fallback seam as ops/pallas_lstm.py):
   - masking uses a large negative (-1e30) everywhere, matching the XLA
     fallback: a fully-masked query row degrades to uniform attention
     instead of NaN.
-  - bf16 i/o supported; compute is f32 in-kernel.
+  - bf16 i/o supported; matmul ACCUMULATION and the online-softmax
+    recurrence (s, m, l, lse) are f32; with bf16 inputs the dot operands
+    (q/k/v/do and the p/ds tiles) run in bf16 for full MXU rate — the
+    standard flash-kernel precision recipe.
 
 lse/delta are carried as [BH, T, 128] lane-replicated f32 (the standard
 layout trick: per-row scalars live on all 128 lanes so no sub-tile
@@ -137,9 +140,12 @@ def _fwd_body(causal, masked, scale, BQ, BK, *refs):
 
     @pl.when(compute)
     def _update():
-        q = q_ref[0].astype(f32)
-        k = k_ref[0].astype(f32)
-        v = v_ref[0].astype(f32)
+        # dots take the refs' NATIVE dtype with f32 accumulation: bf16
+        # inputs run the MXU at full rate (upcasting first would halve
+        # it); the softmax recurrence stays f32 throughout
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=f32) * scale
         if causal:
@@ -153,7 +159,8 @@ def _fwd_body(causal, masked, scale, BQ, BK, *refs):
         l[:] = jnp.broadcast_to(l[:, :1] * corr + p.sum(1, keepdims=True),
                                 l.shape)
         acc[:] = acc[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
         m[:] = jnp.broadcast_to(m_new, m.shape)
 
     @pl.when(j == nj - 1)
@@ -219,10 +226,11 @@ def _dq_body(causal, masked, scale, BQ, BK, *refs):
 
     @pl.when(compute)
     def _update():
-        q = q_ref[0].astype(f32)
-        k = k_ref[0].astype(f32)
-        v = v_ref[0].astype(f32)
-        do = do_ref[0].astype(f32)
+        # native-dtype dot inputs, f32 accumulation (see _fwd_body)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=f32) * scale
         if causal:
@@ -233,7 +241,8 @@ def _dq_body(causal, masked, scale, BQ, BK, *refs):
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=f32)
         ds = p * (dp - delta_ref[0][:, :1]) * scale
-        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        dq_acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
                                          preferred_element_type=f32)
 
     @pl.when(j == nj - 1)
@@ -262,10 +271,11 @@ def _dkv_body(causal, masked, scale, BQ, BK, *refs):
 
     @pl.when(compute)
     def _update():
-        q = q_ref[0].astype(f32)
-        k = k_ref[0].astype(f32)
-        v = v_ref[0].astype(f32)
-        do = do_ref[0].astype(f32)
+        # native-dtype dot inputs, f32 accumulation (see _fwd_body)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=f32) * scale
         if causal:
@@ -274,12 +284,14 @@ def _dkv_body(causal, masked, scale, BQ, BK, *refs):
             s = jnp.where(mask_ref[0][0:1, :] > 0, s, NEG)
         p = jnp.exp(s - lse_ref[0][:, :1])                    # [BQ, BK]
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=f32)
         ds = p * (dp - delta_ref[0][:, :1]) * scale
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32)
 
     @pl.when(i == ni - 1)
     def _finalize():
@@ -385,9 +397,10 @@ def _fwd_carry_body(causal, scale, BQ, BK, *refs):
 
     @pl.when(compute)
     def _update():
-        q = q_ref[0].astype(f32)
-        k = k_ref[0].astype(f32)
-        v = v_ref[0].astype(f32)
+        # native-dtype dot inputs, f32 accumulation (see _fwd_body)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=f32) * scale
         if causal:
@@ -399,7 +412,8 @@ def _fwd_carry_body(causal, scale, BQ, BK, *refs):
         ls[:] = jnp.broadcast_to(ls[:, :1] * corr + p.sum(1, keepdims=True),
                                  ls.shape)
         accs[:] = accs[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
         ms[:] = jnp.broadcast_to(m_new, ms.shape)
 
     @pl.when(j == nj - 1)

@@ -2,6 +2,11 @@
 (shared-gradients mode) and K-step parameter averaging, plus optional
 threshold-compressed gradient exchange. On a single chip this degenerates to
 normal training; on a pod slice the same code shards the batch over ICI."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration

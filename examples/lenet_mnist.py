@@ -1,6 +1,11 @@
 """LeNet on MNIST — the canonical first example (reference
 dl4j-examples LenetMnistExample). Runs on whatever device JAX finds
 (the real TPU chip under this repo's environment)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu import InputType, MultiLayerNetwork, NeuralNetConfiguration

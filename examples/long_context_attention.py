@@ -15,6 +15,11 @@ For sequences too long for ONE chip, shard the time axis instead:
 parallel.ring_attention.ring_attention_sharded (sequence parallelism over
 the mesh's ICI; see examples/pipeline_transformer.py for the mesh setup).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu import (InputType, MultiLayerNetwork,

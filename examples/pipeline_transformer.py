@@ -17,6 +17,11 @@ This example trains a tiny char-level decoder-only transformer end-to-end:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh, or
   as-is on a pod slice.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import jax

@@ -1,6 +1,11 @@
 """Seq2seq ComputationGraph (encoder LSTM -> LastTimeStep ->
 DuplicateToTimeSeries -> decoder LSTM) trained with truncated BPTT, then
 streamed step-by-step with rnn_time_step."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu import InputType, NeuralNetConfiguration

@@ -4,6 +4,11 @@ Demonstrates three round-3 capabilities together: the dictionary+Viterbi
 CJK segmenter (nlp/segmentation.py — the ansj/kuromoji capability), the
 hierarchical-softmax objective (reference useHierarchicSoftmax; batched
 gather over padded Huffman paths), and similarity queries."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.nlp import CJKTokenizerFactory, Word2Vec

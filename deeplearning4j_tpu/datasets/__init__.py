@@ -5,6 +5,7 @@ from .export import (ShardedFileDataSetIterator,
 from .fetchers import (Cifar10DataSetIterator, CurvesDataSetIterator,
                        IrisDataSetIterator, LFWDataSetIterator,
                        load_cifar10, load_curves, load_iris, load_lfw)
+from .prefetch import DevicePrefetchIterator
 from .iterators import (EarlyTerminationDataSetIterator,
                         ExistingDataSetIterator, IteratorDataSetIterator,
                         ListMultiDataSetIterator, MultiDataSet,
@@ -13,7 +14,8 @@ from .mnist import MnistDataSetIterator, load_mnist
 
 __all__ = [
     "AsyncDataSetIterator", "Cifar10DataSetIterator", "CurvesDataSetIterator",
-    "DataSet", "DataSetIterator", "EarlyTerminationDataSetIterator",
+    "DataSet", "DataSetIterator", "DevicePrefetchIterator",
+    "EarlyTerminationDataSetIterator",
     "ExistingDataSetIterator", "IrisDataSetIterator",
     "IteratorDataSetIterator", "LFWDataSetIterator",
     "ListDataSetIterator",

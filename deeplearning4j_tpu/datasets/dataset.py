@@ -39,6 +39,15 @@ class DataSetIterator:
     def reset(self):
         pass
 
+    def prefetch(self, depth: int = 2, *, sharding=None, dtype=None):
+        """Wrap this iterator in a DevicePrefetchIterator: a background
+        thread ships each batch to the device (``jax.device_put``, sharded
+        when ``sharding`` is given) so host->device transfer overlaps the
+        previous step's compute. See datasets/prefetch.py."""
+        from .prefetch import DevicePrefetchIterator
+        return DevicePrefetchIterator(self, depth, sharding=sharding,
+                                      dtype=dtype)
+
 
 class ListDataSetIterator(DataSetIterator):
     """Batches an in-memory dataset (reference ListDataSetIterator)."""

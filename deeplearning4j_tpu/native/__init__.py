@@ -203,13 +203,23 @@ class NativeNpzFile:
                 f"member {name!r}: descr {descr!r} implies {out.nbytes} "
                 f"bytes but native header says {nbytes}")
         lib.sr_read(self._h, i, out.ctypes.data_as(ctypes.c_void_p))
-        if out.dtype.kind == "V" and out.dtype.itemsize == 2:
-            # np.savez stores ml_dtypes bfloat16 as raw '|V2' (np.load
-            # returns the same). The shard format's only 2-byte void
-            # producer is bf16 (datasets/export.py), so view it back —
-            # same recovery as util/distributed_checkpoint.py.
-            import ml_dtypes
-            out = out.view(ml_dtypes.bfloat16)
+        if out.dtype.kind == "V":
+            # np.savez stores ml_dtypes bfloat16 as a raw 2-byte void
+            # ('|V2', or '<V2'/'=V2' depending on the numpy version's
+            # byte-order tag; np.load returns the same). ONLY those exact
+            # descrs are reinterpreted — same recovery as
+            # util/distributed_checkpoint.py; any other void dtype (a
+            # structured record, '|V4', a big-endian '>V2', ...) is not
+            # ours to guess at, so refuse rather than silently mis-type it
+            # (mirrors the nbytes strictness above).
+            if descr in ("|V2", "<V2", "=V2"):
+                import ml_dtypes
+                out = out.view(ml_dtypes.bfloat16)
+            else:
+                raise ValueError(
+                    f"member {name!r}: void dtype descr {descr!r} is not "
+                    "the raw-bfloat16 '|V2' this shard format produces — "
+                    "refusing to reinterpret an unknown void layout")
         return out
 
     def close(self):

@@ -37,7 +37,7 @@ class DistributedWord2Vec(SequenceVectors):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from ..parallel.mesh import shard_map
         from jax.sharding import PartitionSpec as P
         from ..parallel.mesh import make_mesh
 

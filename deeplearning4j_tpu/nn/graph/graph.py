@@ -348,9 +348,15 @@ class ComputationGraph:
         return self._solver_inst
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
-            batch_size: Optional[int] = None, iterator=None, dataset=None):
+            batch_size: Optional[int] = None, iterator=None, dataset=None,
+            async_prefetch: bool = True, prefetch_depth: int = 2):
+        """``async_prefetch``/``prefetch_depth``: iterator feeds (incl.
+        MultiDataSet multi-input batches) run through a
+        DevicePrefetchIterator — see MultiLayerNetwork.fit."""
         self._solver().fit(data=data, labels=labels, epochs=epochs,
-                           batch_size=batch_size, iterator=iterator, dataset=dataset)
+                           batch_size=batch_size, iterator=iterator,
+                           dataset=dataset, async_prefetch=async_prefetch,
+                           prefetch_depth=prefetch_depth)
         return self
 
     def pretrain(self, iterator, epochs: int = 1):

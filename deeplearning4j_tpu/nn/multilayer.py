@@ -329,9 +329,16 @@ class MultiLayerNetwork:
         return self._solver_inst
 
     def fit(self, data=None, labels=None, *, epochs: int = 1, batch_size: Optional[int] = None,
-            iterator=None, dataset=None):
+            iterator=None, dataset=None, async_prefetch: bool = True,
+            prefetch_depth: int = 2):
+        """``async_prefetch``/``prefetch_depth``: iterator feeds run through
+        a DevicePrefetchIterator (datasets/prefetch.py) — batch N+1 is
+        host-prepared AND shipped to the device while step N computes; the
+        per-iteration ETL wait is surfaced via PerformanceListener."""
         self._solver().fit(data=data, labels=labels, epochs=epochs,
-                           batch_size=batch_size, iterator=iterator, dataset=dataset)
+                           batch_size=batch_size, iterator=iterator,
+                           dataset=dataset, async_prefetch=async_prefetch,
+                           prefetch_depth=prefetch_depth)
         return self
 
     def pretrain(self, iterator, epochs: int = 1):

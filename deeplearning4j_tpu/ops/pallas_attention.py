@@ -41,7 +41,14 @@ import numpy as np
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    PALLAS_AVAILABLE = True
+    # renamed across JAX versions: new ships CompilerParams, old
+    # TPUCompilerParams — same fields for the dimension_semantics we pass.
+    # A version exposing NEITHER counts as pallas-unavailable so the
+    # eligibility probes route callers to the XLA fallback instead of
+    # dying on a None call at kernel launch.
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    PALLAS_AVAILABLE = _CompilerParams is not None
 except ImportError:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
@@ -199,7 +206,7 @@ def _fwd(q3, k3, v3, mask2, causal, scale):
         scratch_shapes=[pltpu.VMEM((BQ, D), f32),
                         pltpu.VMEM((BQ, 128), f32),
                         pltpu.VMEM((BQ, 128), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -335,7 +342,7 @@ def _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3):
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((BH, T, D), q3.dtype)],
         scratch_shapes=[pltpu.VMEM((BQ, D), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)[0]
@@ -368,7 +375,7 @@ def _bwd(q3, k3, v3, mask2, causal, scale, o3, lse, do3):
                    jax.ShapeDtypeStruct((BH, T, D), v3.dtype)],
         scratch_shapes=[pltpu.VMEM((BK, D), f32),
                         pltpu.VMEM((BK, D), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -450,7 +457,7 @@ def flash_block_update(acc, m, l, q3, k3, v3, *, causal: bool,
         scratch_shapes=[pltpu.VMEM((BQ, D), f32),
                         pltpu.VMEM((BQ, 128), f32),
                         pltpu.VMEM((BQ, 128), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3, acc, m, l)

@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..datasets.dataset import AsyncDataSetIterator, DataSet, ListDataSetIterator
+from ..datasets.dataset import DataSet, ListDataSetIterator
+from ..datasets.prefetch import DevicePrefetchIterator
 from .listeners import PerformanceListener, TrainingListener
 
 
@@ -129,7 +130,8 @@ class Solver:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data=None, labels=None, *, epochs=1, batch_size=None,
-            iterator=None, dataset=None, async_prefetch: bool = True):
+            iterator=None, dataset=None, async_prefetch: bool = True,
+            prefetch_depth: int = 2):
         net = self.net
         if net.params is None:
             net.init()
@@ -160,7 +162,21 @@ class Solver:
                 bs = batch_size or features.shape[0]
                 iterator = ListDataSetIterator(features=features, labels=labels,
                                                batch_size=bs)
-        it_wrapped = AsyncDataSetIterator(iterator) if async_prefetch else iterator
+        # Device-side prefetch (datasets/prefetch.py): a background thread
+        # pulls + host-prepares batch N+1 AND ships it to the device while
+        # step N computes, so the host->device transfer overlaps device
+        # compute (the reference's AsyncDataSetIterator overlapped only the
+        # host half). A caller-supplied DevicePrefetchIterator (e.g. with a
+        # mesh sharding) is used as-is.
+        if isinstance(iterator, DevicePrefetchIterator):
+            it_wrapped = iterator
+        elif async_prefetch and prefetch_depth >= 1:
+            it_wrapped = DevicePrefetchIterator(iterator, prefetch_depth,
+                                                dtype=net.conf.dtype)
+        else:     # prefetch_depth < 1 opts out, same as async_prefetch=False
+            it_wrapped = iterator
+        prefetcher = (it_wrapped if isinstance(it_wrapped, DevicePrefetchIterator)
+                      else None)
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 7919)
         perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
@@ -169,13 +185,17 @@ class Solver:
             for l in net.listeners:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_start(net)
-            # ETL timing: the gap between iterations spent FETCHING +
-            # host-preparing the batch (reference lastEtlTime, set in the
-            # fit loop MultiLayerNetwork.java:1130 and reported by
-            # PerformanceListener.java:111,178)
+            # ETL timing (reference lastEtlTime, set in the fit loop
+            # MultiLayerNetwork.java:1130 and reported by
+            # PerformanceListener.java:111,178): with device prefetch the
+            # honest number is the time the consumer BLOCKED waiting for a
+            # device-resident batch (zero when the pipeline keeps up);
+            # without it, the gap between iterations spent fetching +
+            # host-preparing the batch.
             _etl_t0 = time.perf_counter()
             for ds in it_wrapped:
-                etl_ms = (time.perf_counter() - _etl_t0) * 1e3
+                etl_ms = (prefetcher.last_wait_ms if prefetcher is not None
+                          else (time.perf_counter() - _etl_t0) * 1e3)
                 x = _cast_any(ds.features, dtype)
                 y = _cast_any(ds.labels, dtype)
                 lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)
@@ -199,8 +219,15 @@ class Solver:
                         jnp.asarray(net.iteration_count, jnp.int32), rng, x, y, **kwargs)
                 # listeners get the index of the last executed iteration
                 it_idx = net.iteration_count - 1 if tbptt else net.iteration_count
+                # device_ms: the iteration's wall time net of ETL wait —
+                # dispatch + device compute (async dispatch lets the host
+                # run ahead, so per-iteration values smooth toward the true
+                # device time as the in-flight queue saturates)
+                device_ms = max(
+                    (time.perf_counter() - _etl_t0) * 1e3 - etl_ms, 0.0)
                 for p in perf:
-                    p.note_batch(ds.num_examples(), etl_ms=etl_ms)
+                    p.note_batch(ds.num_examples(), etl_wait_ms=etl_ms,
+                                 device_ms=device_ms)
                 for l in net.listeners:
                     l.iteration_done(net, it_idx, loss)
                 if not tbptt:
@@ -339,11 +366,25 @@ def _is_multi(x):
             and isinstance(x[0], (np.ndarray, jnp.ndarray)))
 
 
-def _cast_features(x, dtype):
+def cast_feed(x, dtype, *, keep_ints: bool = True):
+    """THE feed-boundary cast, device-resident aware: an array the
+    DevicePrefetchIterator already shipped is never round-tripped through
+    the host (cast on device only if needed); host arrays go through
+    jnp.asarray. ``keep_ints`` preserves integer dtypes (token ids, uint8
+    wire images — the Solver rule); ParallelWrapper passes False to keep
+    its historical everything-to-dtype semantics."""
+    if isinstance(x, jax.Array):
+        if keep_ints and x.dtype.kind in "iu":
+            return x
+        return x if x.dtype == dtype else x.astype(dtype)
     x = np.asarray(x)
-    if x.dtype.kind in "iu":
+    if keep_ints and x.dtype.kind in "iu":
         return jnp.asarray(x)
     return jnp.asarray(x, dtype)
+
+
+def _cast_features(x, dtype):
+    return cast_feed(x, dtype, keep_ints=True)
 
 
 def _cast_any(x, dtype):

@@ -25,17 +25,19 @@ server (reference SharedTrainingMaster.java:46-53 is replaced wholesale).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..datasets.dataset import AsyncDataSetIterator
+from ..datasets.prefetch import DevicePrefetchIterator
 from ..optimize.listeners import PerformanceListener, TrainingListener
-from .mesh import data_sharding, make_mesh, replicated
+from ..optimize.solver import cast_feed
+from .mesh import data_sharding, make_mesh, replicated, shard_map
 
 
 class ParallelWrapper:
@@ -47,6 +49,13 @@ class ParallelWrapper:
 
     ``workers`` is accepted for API familiarity but the device count comes
     from the mesh (every chip is a worker).
+
+    ``prefetch_buffer`` (reference Builder.prefetchBuffer) is the in-flight
+    depth of the input pipeline: on the per-step sync path it is the
+    DevicePrefetchIterator depth — batches ship host->device PRE-SHARDED on
+    the mesh's data axis while the previous step computes; on the K-step
+    averaging path it is the host-side prefetch queue (the K-batch stack is
+    assembled on host).
     """
 
     def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
@@ -194,16 +203,55 @@ class ParallelWrapper:
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 31337)
         perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
-        it_wrapped = AsyncDataSetIterator(iterator, self.prefetch_buffer)
+        if sync:
+            # Device prefetch with the mesh's data sharding: batch N+1 is
+            # shipped PRE-SHARDED (per-device sub-buffers land directly)
+            # while step N computes, so neither the host->device hop nor
+            # the GSPMD resharding sits serially inside the step. The
+            # K-step averaging path below stacks K host batches into one
+            # [K, B, ...] program feed instead, so it keeps the host-side
+            # prefetcher. prefetch_buffer < 1 opts out of prefetching
+            # (the old host wrapper treated 0 as 'unbounded', which for a
+            # device-resident queue would mean unbounded HBM — refuse the
+            # footprint, not the caller).
+            if isinstance(iterator, DevicePrefetchIterator):
+                it_wrapped = iterator
+            elif self.prefetch_buffer >= 1:
+                it_wrapped = DevicePrefetchIterator(
+                    iterator, self.prefetch_buffer, dtype=dtype,
+                    sharding=data_sharding(self.mesh))
+            else:
+                it_wrapped = iterator
+            prefetcher = (it_wrapped
+                          if isinstance(it_wrapped, DevicePrefetchIterator)
+                          else None)
+        else:
+            # host-side prefetch only: _run_avg stacks K host batches into
+            # one [K, B, ...] feed, so a device-resident batch would just
+            # round-trip device->host->device. Unwrap a caller-supplied
+            # DevicePrefetchIterator to its base for the same reason.
+            base = (iterator.base
+                    if isinstance(iterator, DevicePrefetchIterator)
+                    else iterator)
+            it_wrapped = AsyncDataSetIterator(base, self.prefetch_buffer)
+            prefetcher = None
+
+        # historical ParallelWrapper semantics: EVERYTHING to dtype (the
+        # Solver path keeps ints instead — see cast_feed)
+        def feed(v):
+            return cast_feed(v, dtype, keep_ints=False)
 
         for epoch in range(epochs):
             for l in net.listeners:
                 if isinstance(l, TrainingListener):
                     l.on_epoch_start(net)
             if sync:
+                _t0 = time.perf_counter()
                 for ds in it_wrapped:
-                    x = jnp.asarray(np.asarray(ds.features), dtype)
-                    y = jnp.asarray(np.asarray(ds.labels), dtype)
+                    etl_ms = (prefetcher.last_wait_ms if prefetcher is not None
+                              else (time.perf_counter() - _t0) * 1e3)
+                    x = feed(ds.features)
+                    y = feed(ds.labels)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
                     it = jnp.asarray(net.iteration_count, jnp.int32)
                     if self.gradient_accumulator is not None:
@@ -216,8 +264,12 @@ class ParallelWrapper:
                     else:
                         net.params, net.state, net.opt_state, loss = self._sync_step(
                             net.params, net.state, net.opt_state, it, rng, x, y)
-                    self._notify(perf, ds, loss)
+                    device_ms = max(
+                        (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
+                    self._notify(perf, ds, loss, etl_wait_ms=etl_ms,
+                                 device_ms=device_ms)
                     net.iteration_count += 1
+                    _t0 = time.perf_counter()
             else:
                 # accumulate K batches then run the fused K-step+average program
                 buf: List[Any] = []
@@ -250,9 +302,11 @@ class ParallelWrapper:
             self._notify(perf, d, loss)
             net.iteration_count += 1
 
-    def _notify(self, perf, ds, loss):
+    def _notify(self, perf, ds, loss, etl_wait_ms: float = 0.0,
+                device_ms: float = 0.0):
         net = self.net
         for p in perf:
-            p.note_batch(ds.num_examples())
+            p.note_batch(ds.num_examples(), etl_wait_ms=etl_wait_ms,
+                         device_ms=device_ms)
         for l in net.listeners:
             l.iteration_done(net, net.iteration_count, loss)

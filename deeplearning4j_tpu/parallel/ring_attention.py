@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .mesh import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -115,14 +115,16 @@ def _ring_body(q, k0, v0, axis, n, causal, scale, t_local):
 
 def _ring_fused_fwd(q3, k3, v3, axis, n, causal, scale):
     from ..ops.pallas_attention import flash_block_update
-    idx = jax.lax.axis_index(axis)
+    # axis_index only when causality needs it: a dead PartitionId survives
+    # to SPMD partitioning on older XLA CPU backends and aborts the compile
+    idx = jax.lax.axis_index(axis) if causal else None
     BH, t, D = q3.shape
     f32 = jnp.float32
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, j):
         acc, m, l, k, v = carry
-        src = (idx - j) % n
+        src = (idx - j) % n if causal else None
         ops = (acc, m, l)
 
         def diag(o):
@@ -148,7 +150,10 @@ def _ring_fused_fwd(q3, k3, v3, axis, n, causal, scale):
     l = jnp.zeros((BH, t, 128), f32)
     (acc, m, l, _, _), _ = jax.lax.scan(step, (acc, m, l, k3, v3),
                                         jnp.arange(n))
-    o3 = (acc / l[:, :, :1]).astype(q3.dtype)
+    # epsilon guard matching the XLA ring body: a row that accumulated no
+    # probability mass (a future key_mask / all-hops-skipped case) degrades
+    # to zeros instead of NaN
+    o3 = (acc / jnp.maximum(l[:, :, :1], 1e-20)).astype(q3.dtype)
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     return o3, lse
 
@@ -167,14 +172,14 @@ def _ring_fused_fwd_rule(q3, k3, v3, axis, n, causal, scale):
 def _ring_fused_bwd_rule(axis, n, causal, scale, res, do3):
     from ..ops.pallas_attention import flash_block_bwd
     q3, k3, v3, o3, lse = res
-    idx = jax.lax.axis_index(axis)
+    idx = jax.lax.axis_index(axis) if causal else None
     f32 = jnp.float32
     perm = [(i, (i + 1) % n) for i in range(n)]
     zero = (jnp.zeros(q3.shape, f32),) + 2 * (jnp.zeros(k3.shape, f32),)
 
     def step(carry, j):
         dq, dk, dv, k, v = carry
-        src = (idx - j) % n
+        src = (idx - j) % n if causal else None
 
         def diag(ops):
             out = flash_block_bwd(q3, *ops, o3, lse, do3, causal=True,
@@ -246,6 +251,25 @@ def ring_attention_sharded(mesh: Mesh, axis: str = "seq", *,
         fused = use_fused
         if fused is None:
             fused = fused_ring_applicable(t_local, q.shape[-1], q.dtype)
+        elif fused and not (t_local > 0 and t_local % 128 == 0
+                            and (q.shape[-1] % 128 == 0
+                                 or q.shape[-1] in (64, 96))):
+            # validate the explicit opt-in HERE, at the misuse site — the
+            # alternative is a confusing 'T not a multiple of 128'
+            # ValueError from deep inside the Pallas kernel's block sizing
+            # (ops/pallas_attention._blocks) at trace time. Only the HARD
+            # shape constraints are enforced: an explicit True is allowed
+            # to force the interpret path on a non-TPU backend (the
+            # multichip dryrun and the CPU parity tests do exactly that),
+            # which the fused_ring_applicable auto-probe would refuse.
+            raise ValueError(
+                f"use_fused=True, but the fused ring-hop kernels cannot "
+                f"serve this call: t_local = T/ring_size = "
+                f"{q.shape[2]}/{n} = {t_local} must be a positive "
+                f"multiple of 128 (the TPU lane dim), with head dim "
+                f"{q.shape[-1]} in (64, 96, any multiple of 128). Pass "
+                f"use_fused=None to auto-fallback to the XLA ring body "
+                f"instead")
         if fused:
             body = functools.partial(_ring_body_fused, axis=axis, n=n,
                                      causal=causal, scale=sc)

@@ -16,6 +16,16 @@ Composition:
   net.fit(iterator=topic)                         # blocks on the stream
   ...producers POST {"features": [...], "labels": [...]} ...
   topic.end_of_stream()                           # drain + stop the epoch
+
+``net.fit(iterator=topic)`` routes the stream through a
+DevicePrefetchIterator (datasets/prefetch.py): batches are shipped
+host->device on a background thread while the previous step computes.
+Back-pressure is PRESERVED end to end — the prefetcher holds at most
+``depth`` shipped batches (plus one in flight), its producer thread blocks
+on that bounded queue, stops pulling from this topic, and publishers block
+on the topic's own ``capacity`` exactly as without prefetch. To land
+batches pre-sharded for data-parallel consumption:
+``topic.prefetch(depth=2, sharding=data_sharding(mesh))``.
 """
 from __future__ import annotations
 

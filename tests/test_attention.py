@@ -283,3 +283,42 @@ def test_fused_ring_auto_probe_engages():
     np.testing.assert_allclose(np.asarray(jax.device_get(auto(qs, ks, vs))),
                                np.asarray(jax.device_get(xla(qs, ks, vs))),
                                atol=2e-5)
+
+
+def test_use_fused_explicit_misuse_is_a_targeted_error():
+    """Regression (ADVICE r5): forcing use_fused=True on an ineligible
+    local block must raise a targeted error naming t_local and the
+    128-multiple constraint at the misuse site — not a confusing
+    'T not a multiple of 128' from inside the Pallas block sizing."""
+    mesh = make_mesh((2,), ("seq",), jax.devices()[:2])
+    fn = ring_attention_sharded(mesh, "seq", causal=True, use_fused=True)
+    q, k, v = _qkv(B=1, H=2, T=64, D=64)     # t_local = 32: not 128-aligned
+    sh = sequence_sharding(mesh, "seq")
+    with pytest.raises(ValueError, match=r"t_local.*multiple of 128"):
+        fn(*(jax.device_put(t, sh) for t in (q, k, v)))
+
+
+def test_fused_ring_zero_mass_row_degrades_to_zero_not_nan(monkeypatch):
+    """Regression (ADVICE r5): a q row that accumulated NO probability
+    mass (every hop skipped — a future key_mask case) must normalize to
+    zeros via the epsilon guard, matching the XLA ring body, instead of
+    emitting 0/0 NaN. Simulated by stubbing the hop kernel to a no-op."""
+    from deeplearning4j_tpu.ops import pallas_attention as pa
+    from deeplearning4j_tpu.parallel import ring_attention as ra
+    from deeplearning4j_tpu.parallel.mesh import shard_map
+
+    monkeypatch.setattr(pa, "flash_block_update",
+                        lambda acc, m, l, q, k, v, **kw: (acc, m, l))
+    mesh = make_mesh((2,), ("seq",), jax.devices()[:2])
+    spec = P(None, "seq", None)
+
+    def body(q3, k3, v3):
+        o, _ = ra._ring_fused_fwd(q3, k3, v3, "seq", 2, False, 0.125)
+        return o
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    q3 = jnp.asarray(R.normal(size=(2, 256, 64)).astype(np.float32))
+    out = np.asarray(jax.device_get(fn(q3, q3, q3)))
+    assert np.all(np.isfinite(out)), "zero-mass rows produced NaN/inf"
+    np.testing.assert_array_equal(out, np.zeros_like(out))

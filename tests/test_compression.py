@@ -166,7 +166,7 @@ def test_encoded_accumulator_dense_matches_manual():
     residual carries the unsent mass."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from deeplearning4j_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
     from deeplearning4j_tpu.parallel.accumulation import EncodedAccumulator
     from deeplearning4j_tpu.parallel.mesh import make_mesh

@@ -26,7 +26,7 @@ distributed.initialize_distributed(f"127.0.0.1:{port}", num_processes=2,
 assert distributed.process_count() == 2
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map
 
 mesh = distributed.global_mesh(("data",))
 assert mesh.devices.size == 2

@@ -377,3 +377,67 @@ def test_graves_bidirectional_restore_and_predict_parity(tmp_path):
     e = np.exp(z - z.max(-1, keepdims=True))
     np.testing.assert_allclose(np.asarray(net.output(x)),
                                e / e.sum(-1, keepdims=True), atol=2e-4)
+
+
+def _two_layer_conf(lr0=0.1, lr1=0.1, upd0="SGD", upd1="SGD"):
+    import json
+    mk = lambda nin, nout, upd, lr, extra: dict(
+        {"layerName": "l", "activationFunction": "relu", "nin": nin,
+         "nout": nout, "updater": upd, "learningRate": lr, "l1": 0.0,
+         "l2": 0.0, "dropOut": 0.0}, **extra)
+    return json.dumps({"backprop": True, "confs": [
+        {"seed": 1, "pretrain": False,
+         "layer": {"dense": mk(3, 4, upd0, lr0, {})}},
+        {"seed": 1, "pretrain": False,
+         "layer": {"output": mk(4, 5, upd1, lr1,
+                                {"activationFunction": "softmax",
+                                 "lossFunction": "MCXENT"})}}]})
+
+
+def _write_two_layer_zip(path, conf_json):
+    from deeplearning4j_tpu.interop.dl4j_zip import write_nd4j_array
+    n = 3 * 4 + 4 + 4 * 5 + 5
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("configuration.json", conf_json)
+        z.writestr("coefficients.bin", write_nd4j_array(
+            np.linspace(1, n, n, dtype=np.float32).reshape(1, -1),
+            order="c"))
+
+
+def test_heterogeneous_per_layer_updaters_warn_on_import(tmp_path):
+    """Regression (ADVICE r5): DL4J permits per-layer updaters/learning
+    rates; this runtime builds ONE network updater from layer 0. A zip
+    whose layers disagree must say so in import_notes instead of silently
+    training later layers with the wrong optimizer."""
+    p = tmp_path / "hetero.zip"
+    _write_two_layer_zip(p, _two_layer_conf(lr0=0.1, lr1=0.01))
+    net = import_dl4j_zip(str(p))
+    assert any("heterogeneous" in n for n in net.import_notes), \
+        net.import_notes
+
+    # different updater RULE, same lr: also flagged
+    p2 = tmp_path / "hetero2.zip"
+    _write_two_layer_zip(p2, _two_layer_conf(upd0="NESTEROVS", upd1="ADAM"))
+    net2 = import_dl4j_zip(str(p2))
+    assert any("heterogeneous" in n for n in net2.import_notes)
+
+    # layer 0 with NO updater keys (import defaults) vs an explicit Adam
+    # on layer 1: the comparison is against layer 0 — the config the
+    # import actually uses — so this must be flagged too
+    import json
+    conf = json.loads(_two_layer_conf(upd1="ADAM"))
+    for key in ("updater", "learningRate"):
+        del conf["confs"][0]["layer"]["dense"][key]
+    p3 = tmp_path / "hetero3.zip"
+    _write_two_layer_zip(p3, json.dumps(conf))
+    net3 = import_dl4j_zip(str(p3))
+    assert any("heterogeneous" in n for n in net3.import_notes)
+
+
+def test_homogeneous_updaters_import_without_warning(tmp_path):
+    """The common case (one updater everywhere) must stay note-free."""
+    p = tmp_path / "homo.zip"
+    _write_two_layer_zip(p, _two_layer_conf())
+    net = import_dl4j_zip(str(p))
+    assert not any("heterogeneous" in n for n in net.import_notes), \
+        net.import_notes

@@ -99,3 +99,27 @@ def test_compressed_npz_falls_back(tmp_path):
         str(tmp_path / "shards"))
     it = NativeShardedFileDataSetIterator(str(tmp_path / "shards"))
     assert len(list(it)) == 1
+
+
+def test_non_bf16_void_dtype_is_rejected_not_mistyped(tmp_path):
+    """Regression (ADVICE r5): ONLY descr '|V2' (raw bfloat16, the shard
+    format's sole void producer) is reinterpreted; any other void layout
+    (here '|V4') must raise instead of silently passing through — or worse,
+    being viewed — as the wrong type."""
+    path = str(tmp_path / "weird.npz")
+    np.savez(path, arr=np.zeros(4, dtype="V4"))
+    with NativeNpzFile(path) as z:
+        with pytest.raises(ValueError):
+            z["arr"]
+
+
+def test_bf16_v2_members_still_round_trip(tmp_path):
+    """The '|V2' gate must not break the bf16 recovery path."""
+    import jax.numpy as jnp
+    path = str(tmp_path / "bf16.npz")
+    a = np.asarray(jnp.asarray([1.5, -2.25, 0.125], jnp.bfloat16))
+    np.savez(path, w=a)
+    with NativeNpzFile(path) as z:
+        b = z["w"]
+    assert b.dtype == a.dtype
+    np.testing.assert_array_equal(a.view(np.uint16), b.view(np.uint16))

@@ -56,6 +56,13 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
     All three LSTM rows use DEVICE-slope timing (_slope_measure): the
     ~ms-scale per-call tunnel dispatch floor would otherwise swamp the
     ~0.2ms step and compress any real ratio toward 1.0.
+  - dispatch_bound_steps_per_sec   full fit-loop steps/sec, tiny MLP at
+                                   batch 8 (dispatch-bound): K=1 per-step
+                                   dispatch vs K=8 scan-fused windows
+                                   (fit(steps_per_dispatch=8)) + the
+                                   fused_speedup ratio — the measured
+                                   amortization of per-step Python
+                                   dispatch + listener overhead
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
                                    #4), gated on (a) a probe-loss decrease
                                    with a margin far above noise and (b) a
@@ -585,6 +592,75 @@ def bench_piped(batch=128):
                     "transfer_floor_ms the row stays transport-bound even "
                     "with perfect overlap (tunnel-limited on this rig)")}
     return row, dt, flops
+
+
+def bench_dispatch_bound(steps=None, ks=(1, 8), repeats=None):
+    """dispatch_bound_steps_per_sec: full ``Solver.fit`` steps/sec on the
+    config where per-step Python dispatch + listener overhead dominate
+    device compute — a tiny MLP at batch 8 — for K=1 (one jitted dispatch
+    per step) vs K=8 (``steps_per_dispatch=8``: the whole window is ONE
+    buffer-donated lax.scan program, listeners on the sync-free
+    deferred-score protocol). The ratio is the measured dispatch-overhead
+    amortization of the fused path (SparkNet's iteration-batching insight,
+    arXiv:1511.06051); training math is bit-identical between the two
+    columns (tests/test_scan_window.py pins that).
+
+    Chained wall-clock over whole epochs is the CORRECT timing here — the
+    host-side overhead is the thing under test, unlike the device-rate
+    rows — with a value readback per epoch as the completion barrier."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import \
+        CollectScoresIterationListener
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    steps = steps or int(os.environ.get("BENCH_DISPATCH_STEPS", "256"))
+    repeats = repeats or REPEATS
+    batch = 8
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(steps * batch, 32)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=steps * batch)]
+
+    def make_net():
+        conf = (NeuralNetConfiguration(seed=99, updater=Sgd(0.05))
+                .list(DenseLayer(n_in=32, n_out=64, activation="tanh"),
+                      OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # a collecting listener in the loop: the row measures the REAL
+        # dispatch path incl. listener fan-out (per-step float(score)
+        # would re-serialize the loop; the deferred protocol must not)
+        net.set_listeners(CollectScoresIterationListener())
+        return net
+
+    out = {}
+    for k in ks:
+        net = make_net()
+
+        def epoch():
+            net.fit(iterator=ListDataSetIterator(features=x, labels=y,
+                                                 batch_size=batch),
+                    epochs=1, steps_per_dispatch=k)
+            _readback_barrier(net.params)
+
+        epoch()                       # warmup: compile + page in
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            epoch()
+            best = min(best, time.perf_counter() - t0)
+        out[f"k{k}_steps_per_sec"] = round(steps / best, 1)
+    if len(ks) >= 2:
+        a, b = ks[0], ks[-1]
+        out["fused_speedup"] = round(out[f"k{b}_steps_per_sec"]
+                                     / out[f"k{a}_steps_per_sec"], 3)
+        out["note"] = (f"tiny MLP, batch {batch}, {steps} steps/epoch: "
+                       f"K={a} per-step dispatch vs K={b} scan-fused "
+                       f"windows (steps_per_dispatch), chained wall-clock")
+    return out
 
 
 def bench_lstm(cell: str = "graves"):
@@ -1305,6 +1381,7 @@ def main():
             ("transformer_lm_flax_tokens_per_sec", _tlm_flax),
             # cheap rows before the expendable ones: if the budget gates,
             # AMP/piped are the sacrificed tail, not the DCN codec row
+            ("dispatch_bound_steps_per_sec", bench_dispatch_bound),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overhead_by_mesh", bench_collective_overhead),
             ("resnet50_amp_img_per_sec", _amp_ours),

@@ -200,6 +200,110 @@ class DevicePrefetchIterator(DataSetIterator):
         """Mean consumer-side ETL wait over the current/last epoch."""
         return self.total_wait_ms / self.batches if self.batches else 0.0
 
+    def windows(self, k: int):
+        """Window mode: yield ``BatchWindow``s of ``k`` same-shape
+        device-resident batches (the feed unit of the fused multi-step
+        training path, ``fit(..., steps_per_dispatch=k)``), re-using the
+        existing depth-bounded producer queue — the window is assembled
+        from batches that were already shipped in the background, so
+        windowing adds no transfer latency, only the ``jnp.stack``
+        dispatch. Ragged/unstackable groups fall out as bare DataSets
+        (see ``iter_windows``)."""
+        return iter_windows(self, k)
+
     def reset(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+
+
+class BatchWindow:
+    """K same-shape batches destined for ONE fused K-step dispatch.
+
+    Holds the individual ``DataSet``s (listeners still see per-step batch
+    sizes) plus lazily-stacked ``[K, ...]`` feed arrays for the
+    ``lax.scan`` training program. Stacking runs through ``jnp.stack`` on
+    already-device-resident arrays, so it is one async dispatch, not a
+    host round-trip.
+    """
+
+    __slots__ = ("datasets", "_stacked")
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self._stacked = None
+
+    def __len__(self):
+        return len(self.datasets)
+
+    def num_examples(self) -> int:
+        return sum(d.num_examples() for d in self.datasets)
+
+    def stacked(self, cast=None):
+        """(xs, ys, lmasks, fmasks) stacked on a new leading K axis;
+        masks are None when absent from every member batch. ``cast`` is
+        applied per-array before stacking (the Solver's feed-boundary
+        cast, so the fused path casts exactly like the per-step path)."""
+        if self._stacked is None:
+            import jax.numpy as jnp
+            cast = cast if cast is not None else (lambda a: a)
+
+            def stack(field):
+                vals = [getattr(d, field) for d in self.datasets]
+                if vals[0] is None:
+                    return None
+                return jnp.stack([cast(v) for v in vals])
+
+            self._stacked = (stack("features"), stack("labels"),
+                             stack("labels_mask"), stack("features_mask"))
+        return self._stacked
+
+
+def _window_stackable(group) -> bool:
+    """Host-only metadata probe: can these batches be stacked into one
+    [K, ...] feed? Requires single-array features/labels (multi-input
+    MultiDataSet batches fall back to per-step), identical shapes, and
+    consistent mask presence/shape across the group."""
+    ref = group[0]
+    if isinstance(ref, MultiDataSet):
+        return False
+    for field in ("features", "labels", "labels_mask", "features_mask"):
+        vals = [getattr(d, field, None) for d in group]
+        if any(isinstance(v, (list, tuple)) for v in vals):
+            return False           # multi-input lists: per-step path
+        none = [v is None for v in vals]
+        if any(none):
+            if not all(none):
+                return False       # mask present in some batches only
+            if field in ("features", "labels"):
+                return False
+            continue
+        shapes = {np.shape(v) for v in vals}
+        if len(shapes) != 1:
+            return False           # ragged (e.g. short remainder batch)
+    return True
+
+
+def iter_windows(iterable, k: int):
+    """Group a batch stream into ``BatchWindow``s of ``k``.
+
+    Yields a ``BatchWindow`` for every run of ``k`` consecutive
+    same-shape single-array batches, and bare ``DataSet``s for anything
+    the fused path must not swallow: the ragged remainder at end of
+    epoch, a batch whose shape differs mid-window (the whole group falls
+    back — order is preserved), multi-input MultiDataSets, and windows of
+    one. The consumer dispatches fused on windows and per-step on bare
+    batches, so the stream stays order- and content-identical to the
+    unwindowed iterator.
+    """
+    if k < 1:
+        raise ValueError("steps_per_dispatch window size must be >= 1")
+    buf = []
+    for ds in iterable:
+        buf.append(ds)
+        if len(buf) == k:
+            if k > 1 and _window_stackable(buf):
+                yield BatchWindow(buf)
+            else:
+                yield from buf
+            buf = []
+    yield from buf        # ragged remainder: per-step fallback

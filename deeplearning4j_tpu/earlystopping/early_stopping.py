@@ -199,8 +199,17 @@ class EarlyStoppingTrainer:
                 self.tripped = None
 
             def iteration_done(self, model, iteration, score):
+                if not cfg.iteration_termination_conditions:
+                    return
+                # a genuine per-step host-value consumer: ONE readback per
+                # iteration, shared across conditions. Train with
+                # steps_per_dispatch=1 when conditions must act between
+                # individual steps — under a fused K-step window listeners
+                # fire after the window, so termination is window-granular.
+                from ..optimize.listeners import score_to_float
+                s = score_to_float(score)
                 for c in cfg.iteration_termination_conditions:
-                    if c.terminate(float(score)):
+                    if c.terminate(s):
                         self.tripped = c
                         raise _StopTraining()
 

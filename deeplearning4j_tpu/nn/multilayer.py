@@ -330,15 +330,22 @@ class MultiLayerNetwork:
 
     def fit(self, data=None, labels=None, *, epochs: int = 1, batch_size: Optional[int] = None,
             iterator=None, dataset=None, async_prefetch: bool = True,
-            prefetch_depth: int = 2):
+            prefetch_depth: int = 2, steps_per_dispatch: int = 1):
         """``async_prefetch``/``prefetch_depth``: iterator feeds run through
         a DevicePrefetchIterator (datasets/prefetch.py) — batch N+1 is
         host-prepared AND shipped to the device while step N computes; the
-        per-iteration ETL wait is surfaced via PerformanceListener."""
+        per-iteration ETL wait is surfaced via PerformanceListener.
+
+        ``steps_per_dispatch=K``: fuse windows of K same-shape prefetched
+        batches into ONE jitted lax.scan training program (one host
+        round-trip per window instead of per step) — bit-identical to K
+        sequential steps; tBPTT, second-order solvers, and ragged
+        remainder windows automatically run per-step."""
         self._solver().fit(data=data, labels=labels, epochs=epochs,
                            batch_size=batch_size, iterator=iterator,
                            dataset=dataset, async_prefetch=async_prefetch,
-                           prefetch_depth=prefetch_depth)
+                           prefetch_depth=prefetch_depth,
+                           steps_per_dispatch=steps_per_dispatch)
         return self
 
     def pretrain(self, iterator, epochs: int = 1):

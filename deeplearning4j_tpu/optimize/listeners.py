@@ -15,8 +15,35 @@ from typing import Any, List, Optional, Tuple
 log = logging.getLogger("deeplearning4j_tpu")
 
 
+def score_to_float(score) -> float:
+    """Materialize a score to a host float — THE sync point of the
+    listener protocol. ``fit`` hands listeners the device-resident loss
+    scalar (or a [K]-losses slice from a fused scan window) without
+    blocking the dispatch loop; listeners that need a host value call
+    this at log/flush time, so a training step is never serialized
+    behind a scalar readback (the `float(score)`-per-iteration pattern
+    this replaces forced one device sync per step)."""
+    return float(score)
+
+
+class _LazyScoreStr:
+    """Defers the device->host readback past the logging gate: the
+    score materializes only if a handler actually formats the record."""
+
+    __slots__ = ("score",)
+
+    def __init__(self, score):
+        self.score = score
+
+    def __str__(self):
+        return str(score_to_float(self.score))
+
+
 class IterationListener:
     def iteration_done(self, model, iteration: int, score: float):
+        """``score`` is the loss for ``iteration`` — possibly still a
+        device-resident scalar (sync-free listener protocol). Convert
+        with ``score_to_float`` only when a host value is needed."""
         pass
 
 
@@ -38,24 +65,62 @@ class TrainingListener(IterationListener):
 
 
 class ScoreIterationListener(TrainingListener):
-    """Logs score every N iterations (reference ScoreIterationListener)."""
+    """Logs score every N iterations (reference ScoreIterationListener).
+
+    Sync-free: off-cycle iterations never touch the score, and on-cycle
+    ones wrap it in a lazy formatter, so the device scalar is read back
+    only when a log handler actually emits the line — never inside the
+    dispatch loop itself."""
 
     def __init__(self, print_iterations: int = 10):
         self.print_iterations = max(1, print_iterations)
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.print_iterations == 0:
-            log.info("Score at iteration %d is %s", iteration, score)
+            log.info("Score at iteration %d is %s", iteration,
+                     _LazyScoreStr(score))
 
 
 class CollectScoresIterationListener(TrainingListener):
-    def __init__(self, frequency: int = 1):
+    """Collects (iteration, score) pairs — deferred-score protocol: the
+    raw (possibly device-resident) scalars are kept as handed in and
+    materialized to floats in one batch the first time ``.scores`` is
+    read, so collection itself never syncs the training loop.
+
+    ``flush_every`` bounds how many live device scalars are retained: a
+    run that never reads ``.scores`` still materializes (one batched
+    readback) every N collected entries instead of pinning one device
+    buffer per iteration forever."""
+
+    def __init__(self, frequency: int = 1, flush_every: int = 1024):
         self.frequency = max(1, frequency)
-        self.scores: List[Tuple[int, float]] = []
+        self.flush_every = max(1, flush_every)
+        self._raw: List[Tuple[int, Any]] = []
+        self._scores: List[Tuple[int, float]] = []
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.frequency == 0:
-            self.scores.append((iteration, float(score)))
+            self._raw.append((iteration, score))
+            if len(self._raw) >= self.flush_every:
+                self._flush()
+
+    def _flush(self):
+        self._scores.extend((i, score_to_float(s)) for i, s in self._raw)
+        self._raw.clear()
+
+    @property
+    def scores(self) -> List[Tuple[int, float]]:
+        """Flush point: materializes any pending device scalars."""
+        if self._raw:
+            self._flush()
+        return self._scores
+
+    @scores.setter
+    def scores(self, value):
+        # scores was a plain list attribute before the deferred protocol;
+        # keep assignment (e.g. `listener.scores = []` to reset) working
+        self._raw = []
+        self._scores = list(value)
 
 
 class PerformanceListener(TrainingListener):
@@ -72,6 +137,13 @@ class PerformanceListener(TrainingListener):
 
     ``etl_ms_per_iteration`` is kept as an alias of the wait number for
     pre-overlap consumers of ``history``.
+
+    Deferred-score protocol note: this listener materializes the score at
+    REPORT time (each ``frequency``-th iteration), because the history
+    record it publishes is a host-side report. Off-cycle iterations never
+    touch the score; at ``frequency=1`` you are asking for a per-iteration
+    host report, which inherently reads back one scalar per step — raise
+    ``frequency`` to keep a fused ``steps_per_dispatch`` loop sync-free.
     """
 
     def __init__(self, frequency: int = 10, report_samples: bool = True):

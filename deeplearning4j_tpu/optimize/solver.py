@@ -12,6 +12,7 @@ recompiles.
 """
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Optional
 
@@ -20,8 +21,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..datasets.dataset import DataSet, ListDataSetIterator
-from ..datasets.prefetch import DevicePrefetchIterator
+from ..datasets.prefetch import (BatchWindow, DevicePrefetchIterator,
+                                 iter_windows)
 from .listeners import PerformanceListener, TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+def train_step_math(net, params, state, opt_state, it, rng, x, y,
+                    lmask=None, fmask=None):
+    """THE single-step update: loss+grads -> updater -> new carry. Every
+    SGD-path program — Solver per-step and scan-window, ParallelWrapper
+    sync per-step and sync window — traces exactly this function, so the
+    'fused window is bit-identical to K per-step dispatches' contract is
+    structural, not convention."""
+    def lf(p):
+        return net.loss_fn(p, state, x, y, train=True, rng=rng,
+                           labels_mask=lmask, features_mask=fmask)
+    (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+    new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+    return new_params, new_state, new_opt, loss
 
 
 class Solver:
@@ -37,14 +56,52 @@ class Solver:
         net = self.net
 
         def step(params, state, opt_state, it, rng, x, y, lmask=None, fmask=None):
-            def lf(p):
-                return net.loss_fn(p, state, x, y, train=True, rng=rng,
-                                   labels_mask=lmask, features_mask=fmask)
-            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
-            new_params, new_opt = net.updater.update(grads, opt_state, params, it)
-            return new_params, new_state, new_opt, loss
+            return train_step_math(net, params, state, opt_state, it, rng,
+                                   x, y, lmask, fmask)
 
         self._steps[key] = jax.jit(step, donate_argnums=(0, 2))
+        return self._steps[key]
+
+    def _get_window_step(self, has_lmask: bool, has_fmask: bool):
+        """ONE jitted, buffer-donated lax.scan program for a K-step window:
+        params/state/opt_state as carry, stacked [K, ...] batches as xs,
+        per-step losses as ys. The scan body is the same math as
+        ``_get_step`` (fold_in(base_rng, it) -> value_and_grad ->
+        updater.update at iteration ``it``), so K fused steps are
+        bit-identical to K sequential dispatches (gradients always;
+        in pure-f32 runs a stateful updater's elementwise chain may fuse
+        differently in the scan body — <= 1 ulp per step, same math);
+        the window amortizes the per-step Python dispatch to one host
+        round-trip per window.
+        K itself is not part of the cache key — scan length comes from
+        the stacked shapes (XLA recompiles per distinct K, as it would
+        per distinct batch shape)."""
+        key = ("window", has_lmask, has_fmask)
+        if key in self._steps:
+            return self._steps[key]
+        net = self.net
+
+        def window_step(params, state, opt_state, it0, base_rng, xs, ys,
+                        lmasks=None, fmasks=None):
+            seq = (xs, ys) \
+                + ((lmasks,) if has_lmask else ()) \
+                + ((fmasks,) if has_fmask else ())
+
+            def body(carry, inp):
+                params, state, opt_state, it = carry
+                x, y = inp[0], inp[1]
+                lm = inp[2] if has_lmask else None
+                fm = inp[2 + int(has_lmask)] if has_fmask else None
+                rng = jax.random.fold_in(base_rng, it)
+                new_params, new_state, new_opt, loss = train_step_math(
+                    net, params, state, opt_state, it, rng, x, y, lm, fm)
+                return (new_params, new_state, new_opt, it + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, it0), seq)
+            return params, state, opt_state, losses
+
+        self._steps[key] = jax.jit(window_step, donate_argnums=(0, 2))
         return self._steps[key]
 
     def _get_tbptt_step(self, has_lmask: bool, has_fmask: bool, chunk_len: int):
@@ -131,10 +188,12 @@ class Solver:
     # ------------------------------------------------------------------- fit
     def fit(self, data=None, labels=None, *, epochs=1, batch_size=None,
             iterator=None, dataset=None, async_prefetch: bool = True,
-            prefetch_depth: int = 2):
+            prefetch_depth: int = 2, steps_per_dispatch: int = 1):
         net = self.net
         if net.params is None:
             net.init()
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
         tbptt = net.conf.backprop_type == "tbptt"
         algo = getattr(net.conf, "optimization_algorithm", "sgd")
         if algo in ("sgd", "stochastic_gradient_descent"):
@@ -180,6 +239,18 @@ class Solver:
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 7919)
         perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
+        # Fused multi-step dispatch (steps_per_dispatch=K): K prefetched
+        # device-resident batches run through ONE jitted lax.scan program,
+        # so an epoch costs O(num_windows) host round-trips instead of
+        # O(num_steps). tBPTT and second-order solvers keep the per-step
+        # path (their step structure is not a fixed-shape scan body);
+        # ragged remainder windows and unstackable batches fall back
+        # per-step inside iter_windows.
+        fused_k = steps_per_dispatch
+        if fused_k > 1 and (tbptt or second_order is not None):
+            log.debug("steps_per_dispatch=%d ignored: %s path is per-step",
+                      fused_k, "tbptt" if tbptt else "second-order")
+            fused_k = 1
 
         for epoch in range(epochs):
             for l in net.listeners:
@@ -193,9 +264,54 @@ class Solver:
             # without it, the gap between iterations spent fetching +
             # host-preparing the batch.
             _etl_t0 = time.perf_counter()
-            for ds in it_wrapped:
-                etl_ms = (prefetcher.last_wait_ms if prefetcher is not None
-                          else (time.perf_counter() - _etl_t0) * 1e3)
+            _etl_prev_total = 0.0
+            stream = (iter_windows(it_wrapped, fused_k) if fused_k > 1
+                      else it_wrapped)
+            for item in stream:
+                if prefetcher is not None:
+                    # delta of the cumulative wait covers both a single
+                    # batch and a K-batch window's worth of feed blocking.
+                    # When a windowed group falls back to bare batches,
+                    # the group's whole wait lands on its first batch
+                    # (iter_windows pulled all K before yielding) — lumpy
+                    # per-iteration attribution, correct epoch total.
+                    etl_ms = prefetcher.total_wait_ms - _etl_prev_total
+                    _etl_prev_total = prefetcher.total_wait_ms
+                else:
+                    etl_ms = (time.perf_counter() - _etl_t0) * 1e3
+                if isinstance(item, BatchWindow):
+                    k = len(item)
+                    xs, ys, lms, fms = item.stacked(
+                        cast=lambda a: _cast_features(a, dtype))
+                    step_fn = self._get_window_step(lms is not None,
+                                                    fms is not None)
+                    kwargs = {}
+                    if lms is not None:
+                        kwargs["lmasks"] = lms
+                    if fms is not None:
+                        kwargs["fmasks"] = fms
+                    net.params, net.state, net.opt_state, losses = step_fn(
+                        net.params, net.state, net.opt_state,
+                        jnp.asarray(net.iteration_count, jnp.int32),
+                        base_rng, xs, ys, **kwargs)
+                    device_ms = max(
+                        (time.perf_counter() - _etl_t0) * 1e3 - etl_ms, 0.0)
+                    # per-step listener fan-out: losses[i] is a device
+                    # slice — under the deferred-score protocol stock
+                    # listeners read back only on their report/flush
+                    # cycle, never per dispatched step
+                    for i, ds in enumerate(item.datasets):
+                        for p in perf:
+                            p.note_batch(ds.num_examples(),
+                                         etl_wait_ms=etl_ms / k,
+                                         device_ms=device_ms / k)
+                        for l in net.listeners:
+                            l.iteration_done(net, net.iteration_count,
+                                             losses[i])
+                        net.iteration_count += 1
+                    _etl_t0 = time.perf_counter()
+                    continue
+                ds = item
                 x = _cast_any(ds.features, dtype)
                 y = _cast_any(ds.labels, dtype)
                 lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)

@@ -34,9 +34,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import AsyncDataSetIterator
-from ..datasets.prefetch import DevicePrefetchIterator
+from ..datasets.prefetch import BatchWindow, DevicePrefetchIterator, iter_windows
 from ..optimize.listeners import PerformanceListener, TrainingListener
-from ..optimize.solver import cast_feed
+from ..optimize.solver import cast_feed, train_step_math
 from .mesh import data_sharding, make_mesh, replicated, shard_map
 
 
@@ -56,13 +56,20 @@ class ParallelWrapper:
     the mesh's data axis while the previous step computes; on the K-step
     averaging path it is the host-side prefetch queue (the K-batch stack is
     assembled on host).
+
+    ``steps_per_dispatch=K`` (sync path only): windows of K pre-sharded
+    device-resident batches run through ONE jitted lax.scan program —
+    bit-identical to K per-step dispatches, one host round-trip per
+    window. Ragged remainder windows fall back per-step; the averaging
+    path (averaging_frequency>1) is already a fused K-step program and
+    ignores this knob.
     """
 
     def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, training_mode: str = "shared_gradients",
                  average_updaters: bool = True, prefetch_buffer: int = 2,
                  report_score_after_averaging: bool = True,
-                 gradient_accumulator=None):
+                 gradient_accumulator=None, steps_per_dispatch: int = 1):
         self.net = net
         devices = jax.devices()
         if workers is not None and mesh is None:
@@ -84,8 +91,21 @@ class ParallelWrapper:
                 "path (training_mode='shared_gradients'), not K-step parameter "
                 "averaging — the reference makes the same split "
                 "(ParallelWrapper.TrainingMode AVERAGING vs SHARED_GRADIENTS)")
+        # Fused K-step dispatch on the sync all-reduce path (the same
+        # scan-window program as Solver.fit(steps_per_dispatch=K), with
+        # xs/ys landing [K, batch, ...] sharded on the data axis). The
+        # explicit-accumulator path keeps per-step dispatch: its combine
+        # carry is per-worker state threaded outside the scan.
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        if steps_per_dispatch > 1 and gradient_accumulator is not None:
+            raise ValueError(
+                "steps_per_dispatch applies to the plain sync all-reduce "
+                "path; the GradientsAccumulator path dispatches per step")
+        self.steps_per_dispatch = steps_per_dispatch
         self._acc_state = None
         self._sync_step = None
+        self._sync_window_step = None
         self._avg_steps = {}   # keyed by chunk count (remainder batches differ)
 
     # ------------------------------------------------------------- sync path
@@ -95,17 +115,44 @@ class ParallelWrapper:
         mesh = self.mesh
 
         def step(params, state, opt_state, it, rng, x, y):
-            def lf(p):
-                return net.loss_fn(p, state, x, y, train=True, rng=rng)
-            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
-            new_params, new_opt = net.updater.update(grads, opt_state, params, it)
-            return new_params, new_state, new_opt, loss
+            return train_step_math(net, params, state, opt_state, it, rng,
+                                   x, y)
 
         rep = replicated(mesh)
         dsh = data_sharding(mesh)
         return jax.jit(
             step, donate_argnums=(0, 2),
             in_shardings=(rep, rep, rep, rep, rep, dsh, dsh),
+            out_shardings=(rep, rep, rep, rep))
+
+    def _build_sync_window_step(self):
+        """K fused sync-DP steps in ONE jitted lax.scan program: xs/ys are
+        [K, batch, ...] with the batch dim sharded on the data axis (each
+        scan iteration consumes one data-sharded batch; GSPMD inserts the
+        same psum as the per-step program), params/opt_state the donated
+        carry, per-step losses the ys — bit-identical to K sequential
+        ``_build_sync_step`` dispatches."""
+        net = self.net
+        mesh = self.mesh
+
+        def window_step(params, state, opt_state, it0, base_rng, xs, ys):
+            def body(carry, inp):
+                params, state, opt_state, it = carry
+                x, y = inp
+                rng = jax.random.fold_in(base_rng, it)
+                new_params, new_state, new_opt, loss = train_step_math(
+                    net, params, state, opt_state, it, rng, x, y)
+                return (new_params, new_state, new_opt, it + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, it0), (xs, ys))
+            return params, state, opt_state, losses
+
+        rep = replicated(mesh)
+        wsh = NamedSharding(mesh, P(None, "data"))   # [K, batch, ...]
+        return jax.jit(
+            window_step, donate_argnums=(0, 2),
+            in_shardings=(rep, rep, rep, rep, rep, wsh, wsh),
             out_shardings=(rep, rep, rep, rep))
 
     # ------------------------------------------------------ accumulator path
@@ -247,9 +294,38 @@ class ParallelWrapper:
                     l.on_epoch_start(net)
             if sync:
                 _t0 = time.perf_counter()
-                for ds in it_wrapped:
-                    etl_ms = (prefetcher.last_wait_ms if prefetcher is not None
-                              else (time.perf_counter() - _t0) * 1e3)
+                _etl_prev_total = 0.0
+                windowed = (self.steps_per_dispatch > 1
+                            and self.gradient_accumulator is None)
+                stream = (iter_windows(it_wrapped, self.steps_per_dispatch)
+                          if windowed else it_wrapped)
+                for item in stream:
+                    if prefetcher is not None:
+                        etl_ms = prefetcher.total_wait_ms - _etl_prev_total
+                        _etl_prev_total = prefetcher.total_wait_ms
+                    else:
+                        etl_ms = (time.perf_counter() - _t0) * 1e3
+                    if isinstance(item, BatchWindow):
+                        if self._sync_window_step is None:
+                            self._sync_window_step = \
+                                self._build_sync_window_step()
+                        k = len(item)
+                        xs, ys, _, _ = item.stacked(cast=feed)
+                        (net.params, net.state, net.opt_state,
+                         losses) = self._sync_window_step(
+                            net.params, net.state, net.opt_state,
+                            jnp.asarray(net.iteration_count, jnp.int32),
+                            base_rng, xs, ys)
+                        device_ms = max(
+                            (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
+                        for i, d in enumerate(item.datasets):
+                            self._notify(perf, d, losses[i],
+                                         etl_wait_ms=etl_ms / k,
+                                         device_ms=device_ms / k)
+                            net.iteration_count += 1
+                        _t0 = time.perf_counter()
+                        continue
+                    ds = item
                     x = feed(ds.features)
                     y = feed(ds.labels)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)

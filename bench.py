@@ -63,6 +63,15 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    fused_speedup ratio — the measured
                                    amortization of per-step Python
                                    dispatch + listener overhead
+  - serving_throughput             closed-loop concurrent clients (mixed
+                                   request sizes) against the serving/
+                                   InferenceEngine (shape-bucketed dynamic
+                                   batching, AOT-warmed per-bucket programs)
+                                   vs the legacy ParallelInference path
+                                   (every distinct merged batch size traces
+                                   a fresh XLA program at request time):
+                                   req/s + p99 latency at equal offered
+                                   load, + the bucketed_speedup ratio
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
                                    #4), gated on (a) a probe-loss decrease
                                    with a margin far above noise and (b) a
@@ -96,6 +105,8 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    25M-param flat gradient (DCN codec cost)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
+BENCH_SERVING_S (per-mode closed-loop window, default 6),
+BENCH_SERVING_CLIENTS (default 8),
 BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1560),
 BENCH_ROW_CAP_S (per-row SIGALRM cap; default 300), BENCH_PEAK_TFLOPS,
 BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU, BENCH_REPEATS (timed windows per
@@ -660,6 +671,92 @@ def bench_dispatch_bound(steps=None, ks=(1, 8), repeats=None):
         out["note"] = (f"tiny MLP, batch {batch}, {steps} steps/epoch: "
                        f"K={a} per-step dispatch vs K={b} scan-fused "
                        f"windows (steps_per_dispatch), chained wall-clock")
+    return out
+
+
+def bench_serving(duration=None, clients=None, sizes=(1, 2, 3, 5, 8, 13,
+                                                      21, 32)):
+    """serving_throughput: closed-loop concurrent clients at equal offered
+    load against (a) the serving/InferenceEngine — requests coalesced into
+    a 8/32/64 bucket ladder whose forward programs were AOT-compiled at
+    warm-up, so steady state never traces — and (b) the legacy
+    ParallelInference path, where every distinct merged batch size traces
+    a fresh XLA program at request time (the per-shape-recompile tax this
+    row exists to measure). Reports req/s and p99 end-to-end latency per
+    mode; wall-clock chained timing is CORRECT here (host dispatch +
+    compile stalls are the thing under test)."""
+    import threading as _threading
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import InferenceEngine
+
+    duration = duration or float(os.environ.get("BENCH_SERVING_S", "6"))
+    clients = clients or int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+
+    def make_net():
+        conf = (NeuralNetConfiguration(seed=123, updater=Sgd(0.05),
+                                       dtype="float32")
+                .list(DenseLayer(n_in=32, n_out=64, activation="tanh"),
+                      OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    inputs = {n: rng.normal(size=(n, 32)).astype(np.float32) for n in sizes}
+
+    def closed_loop(predict):
+        """clients threads, each submit->wait->submit until the window
+        closes; returns (completed_requests, sorted latencies ms)."""
+        lat, lock = [], _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(tid):
+            k, mine = tid, []
+            while time.perf_counter() < stop_at:
+                x = inputs[sizes[k % len(sizes)]]
+                k += 1
+                t0 = time.perf_counter()
+                predict(x)
+                mine.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lat.extend(mine)
+
+        threads = [_threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lat.sort()
+        return len(lat), lat
+
+    out = {}
+    # --- bucketed: AOT-warmed engine (fresh net = fresh jit caches)
+    eng = InferenceEngine(make_net(), feature_shape=(32,),
+                          buckets=(8, 32, 64), batch_window_ms=1.0,
+                          queue_limit=4096)
+    n, lat = closed_loop(lambda x: eng.predict(x, timeout=60))
+    eng.stop()
+    out["bucketed_req_per_sec"] = round(n / duration, 1)
+    out["bucketed_p99_ms"] = round(lat[int(0.99 * (len(lat) - 1))], 2) \
+        if lat else None
+    # --- unbucketed: legacy dynamic batcher, per-shape request-time traces
+    pi = ParallelInference(make_net(), batch_limit=64, queue_limit=4096)
+    n, lat = closed_loop(pi.output)
+    pi.shutdown()
+    out["unbucketed_req_per_sec"] = round(n / duration, 1)
+    out["unbucketed_p99_ms"] = round(lat[int(0.99 * (len(lat) - 1))], 2) \
+        if lat else None
+    if out["unbucketed_req_per_sec"]:
+        out["bucketed_speedup"] = round(out["bucketed_req_per_sec"]
+                                        / out["unbucketed_req_per_sec"], 3)
+    out["note"] = (f"{clients} closed-loop clients, {duration:.0f}s/mode, "
+                   f"request sizes {list(sizes)}: bucket ladder 8/32/64 "
+                   "AOT-warmed vs legacy per-shape-recompile batcher")
     return out
 
 
@@ -1382,6 +1479,7 @@ def main():
             # cheap rows before the expendable ones: if the budget gates,
             # AMP/piped are the sacrificed tail, not the DCN codec row
             ("dispatch_bound_steps_per_sec", bench_dispatch_bound),
+            ("serving_throughput", bench_serving),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overhead_by_mesh", bench_collective_overhead),
             ("resnet50_amp_img_per_sec", _amp_ours),

@@ -1,4 +1,4 @@
-"""ParallelInference: multi-request inference serving.
+"""ParallelInference: multi-request inference serving (legacy path).
 
 Reference: parallelism/ParallelInference.java:33 — per-device model replicas;
 InferenceMode.BATCHED (default, :53) merges concurrent output() callers into
@@ -8,15 +8,19 @@ round-robins.
 TPU mapping: one jitted forward over the mesh replaces per-device replicas —
 a merged batch is sharded across the 'data' axis, so batching and
 multi-device dispatch are the same operation.
+
+NOTE: this is the simple dynamic batcher. Every distinct merged batch size
+traces a fresh XLA program at request time; for production serving use
+``deeplearning4j_tpu.serving.InferenceEngine`` — shape-bucketed batching
+with AOT-warmed programs, admission control, deadlines and hot-swap.
 """
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, List, Optional
+import time
+from typing import List, Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -39,6 +43,7 @@ class ParallelInference:
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
         self._lock = threading.Lock()
+        self._submit_lock = threading.Lock()
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
         self._worker: Optional[threading.Thread] = None
@@ -46,37 +51,67 @@ class ParallelInference:
             self._worker = threading.Thread(target=self._dispatch_loop, daemon=True)
             self._worker.start()
 
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
     def output(self, x):
         x = np.asarray(x)
         if self.mode != "batched":
+            if self._shutdown:
+                raise RuntimeError("ParallelInference is shut down")
             with self._lock:
                 return np.asarray(self.net.output(x))
         req = _Request(x)
-        self._queue.put(req)
+        # submit under the lock shutdown() takes, so a request can never
+        # slip into the queue after the shutdown drain (it would hang its
+        # caller forever — no worker is left to serve it)
+        while True:
+            with self._submit_lock:
+                if self._shutdown:
+                    raise RuntimeError("ParallelInference is shut down")
+                try:
+                    self._queue.put_nowait(req)
+                    break
+                except queue.Full:
+                    pass
+            time.sleep(0.0005)        # queue full: wait outside the lock
         req.event.wait()
         if req.error is not None:
             raise req.error
         return req.result
 
     def _dispatch_loop(self):
-        while not self._shutdown:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+        carry: Optional[_Request] = None   # deferred overflow request
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if self._shutdown:
+                        return             # drained: shutdown() failed the rest
+                    continue
             batch: List[_Request] = [first]
             total = first.x.shape[0]
-            # scoop up whatever else is queued (up to batch_limit examples)
+            # scoop up whatever else is queued, but NEVER overshoot
+            # batch_limit: an overflow request is carried to the next batch
             deadline = self.max_wait_ms / 1000.0
-            import time
             t0 = time.monotonic()
             while total < self.batch_limit and (time.monotonic() - t0) < deadline:
                 try:
                     r = self._queue.get_nowait()
-                    batch.append(r)
-                    total += r.x.shape[0]
                 except queue.Empty:
+                    if self._shutdown:
+                        break              # drain fast, don't wait the window
                     time.sleep(0.0005)
+                    continue
+                if total + r.x.shape[0] > self.batch_limit:
+                    carry = r              # defer: next batch starts with it
+                    break
+                batch.append(r)
+                total += r.x.shape[0]
             try:
                 merged = np.concatenate([r.x for r in batch], axis=0)
                 out = np.asarray(self.net.output(merged))
@@ -93,6 +128,18 @@ class ParallelInference:
                     r.event.set()
 
     def shutdown(self):
-        self._shutdown = True
+        """Stop the worker and FAIL every request still queued — callers
+        blocked in output() get a RuntimeError instead of hanging, and
+        later output() calls raise instead of enqueueing to nobody."""
+        with self._submit_lock:
+            self._shutdown = True
         if self._worker is not None:
-            self._worker.join(timeout=1.0)
+            self._worker.join(timeout=2.0)
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            r.error = RuntimeError("ParallelInference shut down before "
+                                   "this request was dispatched")
+            r.event.set()

@@ -1,4 +1,4 @@
-"""Model-serving HTTP route.
+"""Model-serving HTTP route (legacy single-model path).
 
 Reference: dl4j-streaming streaming/routes/DL4jServeRouteBuilder.java — the
 Camel/Kafka serving route that feeds incoming arrays to a model and publishes
@@ -6,15 +6,20 @@ predictions. Stdlib HTTP replaces the Camel plumbing; batched inference rides
 ParallelInference (reference ParallelInference.BATCHED), so concurrent
 requests coalesce into one device batch.
 
+For production serving (shape-bucketed batching, AOT-warmed programs,
+admission control, multi-model hot-swap) use
+``deeplearning4j_tpu.serving.ServingHTTPServer``.
+
 Endpoints (JSON):
   POST /predict {"features": [[...], ...]}       -> {"output": [[...], ...]}
-  GET  /health                                   -> {"status": "ok", ...}
+  GET  /health                                   -> {"status": "ok"|"draining",
+                                                     "queue_depth": N, ...}
+Status codes: malformed JSON / bad feature payload -> 400; model or
+device-side failure -> 500; draining -> 503.
 """
 from __future__ import annotations
 
-import json
 import threading
-from typing import Optional
 
 import numpy as np
 
@@ -33,6 +38,7 @@ class ModelServingServer:
         self._thread = None
         self._count = 0
         self._count_lock = threading.Lock()
+        self._draining = False
 
     @property
     def port(self) -> int:
@@ -47,9 +53,15 @@ class ModelServingServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):   # noqa: N802
                 if self.path == "/health":
-                    write_json(self, 200, {"status": "ok",
-                                           "model": type(server.net).__name__,
-                                           "requests_served": server._count})
+                    depth = (server._pi.queue_depth
+                             if server._pi is not None else 0)
+                    body = {"status": ("draining" if server._draining
+                                       else "ok"),
+                            "draining": server._draining,
+                            "queue_depth": depth,
+                            "model": type(server.net).__name__,
+                            "requests_served": server._count}
+                    write_json(self, 503 if server._draining else 200, body)
                 else:
                     self.send_error(404)
 
@@ -57,18 +69,31 @@ class ModelServingServer:
                 if self.path != "/predict":
                     self.send_error(404)
                     return
-                try:
+                if server._draining:
+                    write_json(self, 503, {"error": "server is draining"})
+                    return
+                try:            # parse/validate phase: caller's fault -> 400
                     req = read_json(self)
                     x = np.asarray(req["features"], np.float32)
+                except Exception as e:
+                    write_json(self, 400, {"error": f"bad request: {e}"})
+                    return
+                try:            # inference phase: server's fault -> 500
                     if server._pi is not None:
                         out = server._pi.output(x)
                     else:
                         out = server.net.output(x)
-                    with server._count_lock:   # handler threads race here
-                        server._count += 1
-                    write_json(self, 200, {"output": np.asarray(out).tolist()})
                 except Exception as e:
-                    write_json(self, 400, {"error": str(e)})
+                    # a request that slipped past the drain check and was
+                    # failed by the shutdown is a routine drain, not a 500
+                    if server._draining:
+                        write_json(self, 503, {"error": "server is draining"})
+                    else:
+                        write_json(self, 500, {"error": str(e)})
+                    return
+                with server._count_lock:   # handler threads race here
+                    server._count += 1
+                write_json(self, 200, {"output": np.asarray(out).tolist()})
 
             def log_message(self, *a):
                 pass
@@ -81,9 +106,12 @@ class ModelServingServer:
         return self.port
 
     def stop(self):
+        # drain first: new requests see 503 while in-flight ones finish or
+        # are failed by the ParallelInference shutdown (never left hanging)
+        self._draining = True
+        if self._pi is not None:
+            self._pi.shutdown()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        if self._pi is not None:
-            self._pi.shutdown()

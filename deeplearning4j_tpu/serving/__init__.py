@@ -1,0 +1,41 @@
+"""serving/ — production inference engine.
+
+The upgrade path from ``parallel.ParallelInference`` + ``ModelServingServer``
+(the reproduction of reference ParallelInference.BATCHED +
+DL4jServeRouteBuilder): requests coalesce into padded batches drawn from a
+fixed bucket ladder, every bucket's forward program is AOT-compiled ONCE at
+warm-up (``jax.jit(...).lower(...).compile()``), so steady-state serving
+never traces or recompiles — the cuDNN insight (shape-specialized programs,
+arXiv:1410.0759) applied to whole-model XLA programs, plus SparkNet-style
+batch coalescing across callers (arXiv:1511.06051).
+
+Pillars:
+  - buckets.py   bucket ladder + padding-waste accounting
+  - batcher.py   bounded-queue dynamic batcher: deadlines, fast-fail
+                 admission, drain-then-stop shutdown
+  - programs.py  AOT-warmed per-bucket executables (single-host or
+                 mesh-sharded on the 'data' axis)
+  - registry.py  named models loaded from model zips / checkpoint dirs
+  - engine.py    the facade: multi-model routing + zero-downtime hot-swap
+  - metrics.py   p50/p99 latency, queue-wait, occupancy, padding waste,
+                 rejection counters; XLA compile counter
+  - http.py      /predict /health /metrics /models /reload with real
+                 status codes (400/404/429/500/503/504)
+"""
+from .buckets import BucketLadder
+from .batcher import ShapeBucketedBatcher
+from .engine import InferenceEngine
+from .errors import (DeadlineExceededError, DrainingError, QueueFullError,
+                     ServingError, ShapeMismatchError, UnknownModelError)
+from .metrics import ServingMetrics, xla_compile_count
+from .http import ServingHTTPServer
+from .programs import ProgramSet
+from .registry import ModelRegistry, load_net
+
+__all__ = [
+    "BucketLadder", "ShapeBucketedBatcher", "InferenceEngine",
+    "ServingError", "QueueFullError", "DrainingError",
+    "DeadlineExceededError", "UnknownModelError", "ShapeMismatchError",
+    "ServingMetrics", "xla_compile_count", "ServingHTTPServer",
+    "ProgramSet", "ModelRegistry", "load_net",
+]

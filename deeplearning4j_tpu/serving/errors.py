@@ -1,0 +1,29 @@
+"""Serving error taxonomy — each maps to ONE HTTP status code (http.py), so
+admission decisions made deep in the batcher surface as the right wire
+response instead of the legacy blanket 400."""
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class; http.py maps subclasses to status codes."""
+
+
+class QueueFullError(ServingError):
+    """Admission refused: the model's bounded queue is at capacity (429)."""
+
+
+class DrainingError(ServingError):
+    """Admission refused: the engine/model is draining or stopped (503)."""
+
+
+class DeadlineExceededError(ServingError):
+    """The caller's deadline expired before a result was ready (504)."""
+
+
+class UnknownModelError(ServingError):
+    """No model registered under the requested name (404)."""
+
+
+class ShapeMismatchError(ServingError):
+    """Request feature shape/dtype doesn't match the model's warmed
+    programs (400) — the ladder is compiled for one trailing shape."""

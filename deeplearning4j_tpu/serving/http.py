@@ -1,0 +1,151 @@
+"""HTTP surface for the inference engine.
+
+Replaces the legacy ModelServingServer's predict/health pair with a full
+serving API and REAL status codes (the legacy route collapsed every failure
+to 400):
+
+  POST /predict            {"features": [[...]], "timeout_ms"?: int}
+  POST /predict/<model>    same, routed to a named model
+  GET  /health             200 ok / 503 draining, queue depths per model
+  GET  /metrics            per-model p50/p99, occupancy, waste, rejections
+  GET  /models             registry listing (version, buckets, warm state)
+  POST /reload             {"model": name, "path": zip-or-checkpoint-dir}
+                           -> zero-downtime hot-swap, returns new version
+
+Status mapping: malformed payload -> 400, unknown model -> 404, queue full
+-> 429, model/device-side failure -> 500, draining/stopped -> 503,
+deadline expired -> 504.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .errors import (DeadlineExceededError, DrainingError, QueueFullError,
+                     ShapeMismatchError, UnknownModelError)
+
+_STATUS = ((ShapeMismatchError, 400), (UnknownModelError, 404),
+           (QueueFullError, 429), (DrainingError, 503),
+           (DeadlineExceededError, 504))
+
+
+def status_for(exc: BaseException) -> int:
+    for cls, code in _STATUS:
+        if isinstance(exc, cls):
+            return code
+    return 500
+
+
+class ServingHTTPServer:
+    def __init__(self, engine: InferenceEngine, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.engine = engine
+        self.host = host
+        self._port = port
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> int:
+        import http.server as hs
+
+        from ..util.httpjson import read_json, write_json
+        engine = self.engine
+
+        class Handler(hs.BaseHTTPRequestHandler):
+            def do_GET(self):       # noqa: N802
+                if self.path == "/health":
+                    depths = engine.queue_depths()
+                    body = {"status": ("draining" if engine.draining
+                                       else "ok"),
+                            "draining": engine.draining,
+                            "models": engine.registry.names(),
+                            "queue_depth": depths,
+                            "queue_depth_total": sum(depths.values())}
+                    write_json(self, 503 if engine.draining else 200, body)
+                elif self.path == "/metrics":
+                    write_json(self, 200, engine.metrics())
+                elif self.path == "/models":
+                    write_json(self, 200, engine.models())
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):      # noqa: N802
+                if self.path == "/predict" or \
+                        self.path.startswith("/predict/"):
+                    self._predict()
+                elif self.path == "/reload":
+                    self._reload()
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def _predict(self):
+                model: Optional[str] = None
+                if self.path.startswith("/predict/"):
+                    model = self.path[len("/predict/"):] or None
+                try:                                   # parse phase -> 400
+                    req = read_json(self)
+                    feats = req["features"]
+                    x = np.asarray(feats, np.dtype(engine.dtype))
+                    timeout = req.get("timeout_ms")
+                    timeout = None if timeout is None else float(timeout) / 1e3
+                except Exception as e:
+                    write_json(self, 400, {"error": f"bad request: {e}"})
+                    return
+                try:                                   # serve phase -> taxonomy
+                    out = engine.predict(x, model=model, timeout=timeout)
+                except Exception as e:
+                    write_json(self, status_for(e),
+                               {"error": str(e),
+                                "kind": type(e).__name__})
+                    return
+                write_json(self, 200, {"output": np.asarray(out).tolist(),
+                                       "model": model
+                                       or engine.registry.default_name})
+
+            def _reload(self):
+                try:
+                    req = read_json(self)
+                    name = req["model"]
+                    path = req["path"]
+                    if not isinstance(name, str) or not isinstance(path, str):
+                        raise TypeError("'model' and 'path' must be strings")
+                except Exception as e:
+                    write_json(self, 400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    version = engine.hot_swap(name, path)
+                except UnknownModelError as e:
+                    write_json(self, 404, {"error": str(e)})
+                except FileNotFoundError as e:
+                    write_json(self, 400, {"error": str(e)})
+                except Exception as e:
+                    write_json(self, 500, {"error": str(e)})
+                else:
+                    write_json(self, 200, {"model": name, "version": version,
+                                           "status": "swapped"})
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = hs.ThreadingHTTPServer((self.host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="serving-http")
+        self._thread.start()
+        return self.port
+
+    def stop(self, drain: bool = True) -> None:
+        """Drain-then-stop: new requests see 503 while queued work flushes,
+        then the listener goes down."""
+        self.engine.stop(drain=drain)
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

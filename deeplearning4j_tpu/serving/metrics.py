@@ -1,0 +1,132 @@
+"""Serving observability: per-model latency/queue/occupancy/rejection
+counters + a process-wide XLA compile counter.
+
+The compile counter rides ``jax.monitoring`` (every backend compile emits a
+``/jax/core/compile/backend_compile_duration`` event) — it counts REAL XLA
+compilations anywhere in the process, so the zero-recompile-after-warm-up
+guarantee is asserted against the runtime itself, not against bookkeeping
+the engine could forget to do. Snapshots plug into the existing stats
+machinery via ``publish()`` (ui/storage.py StatsStorage contract — the same
+route StatsListener uses)."""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_count = 0
+_counter_installed = False
+_install_lock = threading.Lock()
+
+
+def _install_compile_counter() -> None:
+    global _counter_installed
+    with _install_lock:
+        if _counter_installed:
+            return
+        import jax.monitoring
+
+        def _on_duration(name, secs, **kw):
+            global _compile_count
+            if name == _BACKEND_COMPILE_EVENT:
+                _compile_count += 1
+
+        # jax 0.4.x has register but no unregister for a single listener;
+        # one increment-only listener installed once per process is inert.
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _counter_installed = True
+
+
+def xla_compile_count() -> int:
+    """Process-wide XLA backend-compile count. Take a snapshot after
+    warm-up; any later increase means something recompiled."""
+    _install_compile_counter()
+    return _compile_count
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """Per-model counters. Latency percentiles come from a bounded ring of
+    the most recent ``window`` observations (enough for stable p99 at
+    serving rates without unbounded memory)."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._lat_ms = deque(maxlen=window)
+        self._qwait_ms = deque(maxlen=window)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batch_rows = 0
+        self.padded_rows = 0
+        self.per_bucket: Dict[int, int] = {}
+        self.rejected: Dict[str, int] = {"full": 0, "draining": 0,
+                                         "deadline": 0, "error": 0}
+        self.swaps = 0
+        self._t0 = time.monotonic()
+
+    # ------------------------------------------------------------- recording
+    def record_request(self, latency_ms: float, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._lat_ms.append(latency_ms)
+
+    def record_queue_wait(self, queue_wait_ms: float) -> None:
+        with self._lock:
+            self._qwait_ms.append(queue_wait_ms)
+
+    def record_batch(self, bucket: int, rows: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += rows
+            self.padded_rows += bucket - rows
+            self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
+
+    def record_rejection(self, kind: str) -> None:
+        with self._lock:
+            self.rejected[kind] = self.rejected.get(kind, 0) + 1
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+
+    # ------------------------------------------------------------- reporting
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            qw = sorted(self._qwait_ms)
+            dispatched = self.batch_rows + self.padded_rows
+            occupancy = self.batch_rows / dispatched if dispatched else 0.0
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "latency_ms": {"p50": round(_percentile(lat, 0.50), 3),
+                               "p99": round(_percentile(lat, 0.99), 3)},
+                "queue_wait_ms": {"p50": round(_percentile(qw, 0.50), 3),
+                                  "p99": round(_percentile(qw, 0.99), 3)},
+                "batch_occupancy": round(occupancy, 4),
+                "padding_waste": round(1.0 - occupancy, 4) if dispatched else 0.0,
+                "per_bucket": dict(self.per_bucket),
+                "rejected": dict(self.rejected),
+                "hot_swaps": self.swaps,
+                "uptime_s": round(time.monotonic() - self._t0, 1),
+            }
+
+    def publish(self, storage, session_id: str = "serving",
+                worker_id: str = "default") -> dict:
+        """Push a snapshot into a StatsStorage backend (ui/storage.py) — the
+        serving analogue of StatsListener's training reports, so dashboards
+        and the remote router see serving metrics through the same SPI."""
+        snap = self.snapshot()
+        storage.put_update(session_id, worker_id, snap)
+        return snap

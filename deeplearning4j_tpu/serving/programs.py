@@ -1,0 +1,167 @@
+"""AOT-warmed forward programs: one compiled XLA executable per bucket.
+
+Warm-up lowers and compiles ``jax.jit(forward)`` for every rung of the
+bucket ladder up front (``jit(...).lower(...).compile()``), so the serving
+hot path only ever CALLS executables — it never traces. Params/state are
+arguments, not constants, which is what makes hot-swap free: a new model
+with identical param/state shapes reuses the same executables and the swap
+is a reference assignment; a changed architecture warms a fresh set BEFORE
+the swap, so serving never waits on a compile.
+
+Mesh mode: the merged batch lands sharded on the 'data' axis, params/state
+replicated — the same mapping parallel/inference.py documents (batching and
+multi-device dispatch are the same operation on TPU).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .buckets import BucketLadder
+
+
+def _tree_signature(tree) -> Tuple:
+    """Hashable (structure, shapes, dtypes) signature of a pytree — two
+    models with equal signatures can share compiled executables."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),
+            tuple((tuple(l.shape), str(jnp.asarray(l).dtype)) for l in leaves))
+
+
+def _arch_key(net) -> Optional[str]:
+    """Architecture identity beyond shapes: the config JSON minus the seed
+    (same-shaped nets can still differ in activation/layer type — reusing
+    the old executables for those would silently serve the wrong math;
+    the seed is irrelevant to the traced forward, so seed-only differences
+    keep the free-swap fast path)."""
+    conf = getattr(net, "conf", None)
+    if conf is None or not hasattr(conf, "to_json"):
+        return None
+    import json
+    try:
+        d = json.loads(conf.to_json())
+        d.pop("seed", None)
+        if isinstance(d.get("config"), dict):    # serde wraps the conf body
+            d["config"].pop("seed", None)
+        return json.dumps(d, sort_keys=True)
+    except Exception:       # pragma: no cover - exotic conf: shape-only match
+        return None
+
+
+def default_forward(net) -> Callable:
+    """Pure forward for MultiLayerNetwork-style nets: (params, state, x) ->
+    output activations, inference mode."""
+    def fwd(params, state, x):
+        return net._output_pure(params, state, x, train=False)
+    return fwd
+
+
+class ProgramSet:
+    """One model version's warmed executables + the params they close over.
+
+    Immutable after ``warm()`` — the engine swaps whole ProgramSets
+    atomically, and an in-flight batch keeps serving on the set it
+    snapshotted at dispatch time.
+    """
+
+    def __init__(self, net, *, feature_shape: Tuple[int, ...],
+                 ladder: BucketLadder, dtype="float32", mesh=None,
+                 data_axis: str = "data",
+                 forward_fn: Optional[Callable] = None,
+                 trace_hook: Optional[Callable[[], None]] = None):
+        self.net = net
+        self.feature_shape = tuple(int(d) for d in feature_shape)
+        self.ladder = ladder
+        self.dtype = jnp.dtype(dtype)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self._custom_fwd = forward_fn
+        self._fwd = forward_fn or default_forward(net)
+        self._trace_hook = trace_hook
+        self._compiled: Dict[int, Any] = {}
+        self._x_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ladder.validate_for_mesh(mesh, data_axis)
+            self._x_sharding = NamedSharding(mesh, P(data_axis))
+            rep = NamedSharding(mesh, P())
+            self.params = jax.device_put(net.params, rep)
+            self.state = jax.device_put(net.state, rep)
+        else:
+            self.params = jax.tree.map(jnp.asarray, net.params)
+            self.state = jax.tree.map(jnp.asarray, net.state)
+        self.signature = (_tree_signature(self.params),
+                          _tree_signature(self.state),
+                          _arch_key(net),
+                          self.feature_shape, str(self.dtype),
+                          self.ladder.rungs, id(mesh))
+
+    # ---------------------------------------------------------------- warm-up
+    def warm(self) -> "ProgramSet":
+        """Compile every rung. Called once at server start / before a swap
+        that changed shapes — NEVER on the request path."""
+        def traced(params, state, x):
+            if self._trace_hook is not None:
+                self._trace_hook()   # trace-time side effect: counts traces
+            return self._fwd(params, state, x)
+
+        for b in self.ladder:
+            x_spec = jax.ShapeDtypeStruct((b,) + self.feature_shape,
+                                          self.dtype)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rep = NamedSharding(self.mesh, P())
+                jitted = jax.jit(traced,
+                                 in_shardings=(jax.tree.map(lambda _: rep,
+                                                            self.params),
+                                               jax.tree.map(lambda _: rep,
+                                                            self.state),
+                                               self._x_sharding))
+            else:
+                jitted = jax.jit(traced)
+            self._compiled[b] = jitted.lower(
+                self.params, self.state, x_spec).compile()
+            # touch the executable once so first real traffic doesn't pay
+            # one-time dispatch setup either
+            pad = np.zeros((b,) + self.feature_shape, self.dtype)
+            np.asarray(self.run(pad))
+        return self
+
+    @property
+    def warmed(self) -> bool:
+        return set(self._compiled) == set(self.ladder.rungs)
+
+    # ---------------------------------------------------------------- serving
+    def run(self, padded: np.ndarray) -> np.ndarray:
+        """Execute the pre-compiled program for ``padded.shape[0]`` rows.
+        Host-side work is numpy-only (no jnp ops → nothing to compile)."""
+        b = padded.shape[0]
+        compiled = self._compiled.get(b)
+        if compiled is None:
+            from .errors import ServingError
+            raise ServingError(
+                f"no warmed program for bucket {b} (warmed: "
+                f"{sorted(self._compiled)}) — call warm()/warm_up() before "
+                "serving")
+        x = padded
+        if self._x_sharding is not None:
+            x = jax.device_put(padded, self._x_sharding)
+        return np.asarray(compiled(self.params, self.state, x))
+
+    def with_params_from(self, net) -> "ProgramSet":
+        """Hot-swap fast path: same architecture (equal signatures) →
+        new ProgramSet sharing THIS set's executables, new params/state.
+        Raises ValueError when shapes differ (caller warms a fresh set)."""
+        new = ProgramSet(net, feature_shape=self.feature_shape,
+                         ladder=self.ladder, dtype=self.dtype,
+                         mesh=self.mesh, data_axis=self.data_axis,
+                         forward_fn=self._custom_fwd,
+                         trace_hook=self._trace_hook)
+        if new.signature != self.signature:
+            raise ValueError("parameter/state shapes changed; full warm-up "
+                             "required")
+        new._compiled = self._compiled   # shared: programs are shape-keyed
+        return new

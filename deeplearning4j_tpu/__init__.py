@@ -6,11 +6,12 @@ dachylong/deeplearning4j @ 0.8.1-SNAPSHOT).
 """
 __version__ = "0.1.0"
 
+from . import telemetry
 from .nn.conf.config import NeuralNetConfiguration, MultiLayerConfiguration
 from .nn.inputs import InputType
 from .nn.multilayer import MultiLayerNetwork
 
 __all__ = [
     "NeuralNetConfiguration", "MultiLayerConfiguration", "InputType",
-    "MultiLayerNetwork",
+    "MultiLayerNetwork", "telemetry",
 ]

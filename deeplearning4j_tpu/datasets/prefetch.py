@@ -32,6 +32,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..telemetry import get_registry
 from .dataset import DataSet, DataSetIterator
 from .iterators import MultiDataSet
 
@@ -151,12 +152,23 @@ class DevicePrefetchIterator(DataSetIterator):
                     continue
             return False
 
+        # Telemetry (telemetry/): ship latency + consumer stall histograms
+        # and a queue-depth gauge replace the ad-hoc etl_wait_ms plumbing
+        # as the shared reporting surface (the attributes below stay for
+        # the PerformanceListener contract). All host-side clock reads —
+        # nothing touches the in-flight device buffers.
+        reg = get_registry()
+
         def producer():
             try:
                 for ds in self.base:
                     if stop.is_set():
                         return
-                    if not offer(self._ship(ds)):
+                    t_ship = time.perf_counter()
+                    shipped = self._ship(ds)
+                    reg.histogram("prefetch.ship_ms").observe(
+                        (time.perf_counter() - t_ship) * 1e3)
+                    if not offer(shipped):
                         return
             except BaseException as e:     # surfaced on the consumer side
                 err.append(e)
@@ -178,6 +190,10 @@ class DevicePrefetchIterator(DataSetIterator):
                 self.last_wait_ms = wait_ms
                 self.total_wait_ms += wait_ms
                 self.batches += 1
+                if reg.enabled:
+                    reg.histogram("prefetch.wait_ms").observe(wait_ms)
+                    reg.gauge("prefetch.queue_depth").set(q.qsize())
+                    reg.counter("prefetch.batches").inc()
                 yield item
         finally:
             # break / exception / exhaustion: stop the producer and let it

@@ -144,6 +144,24 @@ class PerformanceListener(TrainingListener):
     touch the score; at ``frequency=1`` you are asking for a per-iteration
     host report, which inherently reads back one scalar per step — raise
     ``frequency`` to keep a fused ``steps_per_dispatch`` loop sync-free.
+
+    Fused-window accounting (``steps_per_dispatch=K``): the solver calls
+    ``note_window(k)`` before a window's K-step listener fan-out. A report
+    that falls due mid-window is DEFERRED to the window's last step —
+    all K fan-out calls share one timestamp, so a mid-window report would
+    charge the full window wall-time to only part of its steps and push
+    the rest into the next interval at ~zero elapsed time (the historical
+    under-report of K-fused iterations). Window-aligned reports count
+    every fused step against the wall time that actually produced it, and
+    the record additionally carries ``windowed_steps_per_sec`` (per-step
+    throughput counting each fused step) and ``steps_per_dispatch`` (mean
+    steps per host dispatch over the report interval). The log line
+    format is unchanged.
+
+    Each report also lands in the shared telemetry registry
+    (``telemetry.get_registry()``): ``train.samples_per_sec`` /
+    ``train.batches_per_sec`` / ``train.steps_per_dispatch`` gauges and
+    ``train.etl_wait_ms`` / ``train.device_ms`` histograms.
     """
 
     def __init__(self, frequency: int = 10, report_samples: bool = True):
@@ -154,32 +172,62 @@ class PerformanceListener(TrainingListener):
         self._batches = 0
         self._etl_ms = 0.0
         self._device_ms = 0.0
+        self._window_left = 0     # fan-out calls remaining in current window
+        self._dispatches = 0      # host dispatches (a K-window counts once)
+        self._report_due = False
         self.history: List[dict] = []
+
+    def note_window(self, k: int):
+        """Solver hook: the next ``k`` note_batch/iteration_done calls
+        belong to ONE fused dispatch."""
+        self._window_left = k
+        self._dispatches += 1
 
     def note_batch(self, n_samples: int, etl_ms: float = 0.0,
                    etl_wait_ms: Optional[float] = None,
                    device_ms: float = 0.0):
         self._samples += n_samples
         self._batches += 1
+        if self._window_left == 0:   # fused steps were counted by note_window
+            self._dispatches += 1
         self._etl_ms += etl_ms if etl_wait_ms is None else etl_wait_ms
         self._device_ms += device_ms
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()
+        mid_window = False
+        if self._window_left:
+            self._window_left -= 1
+            mid_window = self._window_left > 0
         if self._last_time is None:
             self._last_time = now
             return
-        if iteration % self.frequency == 0 and self._batches:
+        if iteration % self.frequency == 0:
+            self._report_due = True
+        if self._report_due and not mid_window and self._batches:
+            self._report_due = False
             dt = max(now - self._last_time, 1e-9)
             etl_per_it = self._etl_ms / self._batches
+            steps_per_dispatch = self._batches / max(1, self._dispatches)
             rec = {"iteration": iteration,
                    "samples_per_sec": self._samples / dt,
                    "batches_per_sec": self._batches / dt,
                    "etl_ms_per_iteration": etl_per_it,
                    "etl_wait_ms_per_iteration": etl_per_it,
                    "device_ms_per_iteration": self._device_ms / self._batches,
+                   "windowed_steps_per_sec": self._batches / dt,
+                   "steps_per_dispatch": steps_per_dispatch,
                    "score": float(score)}
             self.history.append(rec)
+            from ..telemetry import get_registry
+            reg = get_registry()
+            if reg.enabled:
+                reg.gauge("train.samples_per_sec").set(rec["samples_per_sec"])
+                reg.gauge("train.batches_per_sec").set(rec["batches_per_sec"])
+                reg.gauge("train.steps_per_dispatch").set(steps_per_dispatch)
+                reg.histogram("train.etl_wait_ms").observe(etl_per_it)
+                reg.histogram("train.device_ms").observe(
+                    rec["device_ms_per_iteration"])
             log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, "
                      "etl wait %.2f ms/it, device %.2f ms/it, score=%.5f",
                      iteration, rec["samples_per_sec"],
@@ -189,6 +237,7 @@ class PerformanceListener(TrainingListener):
             self._last_time = now
             self._samples = 0
             self._batches = 0
+            self._dispatches = 0
             self._etl_ms = 0.0
             self._device_ms = 0.0
 

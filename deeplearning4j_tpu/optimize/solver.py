@@ -23,6 +23,7 @@ import numpy as np
 from ..datasets.dataset import DataSet, ListDataSetIterator
 from ..datasets.prefetch import (BatchWindow, DevicePrefetchIterator,
                                  iter_windows)
+from ..telemetry import device_memory_gauges, get_registry, span
 from .listeners import PerformanceListener, TrainingListener
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -252,35 +253,57 @@ class Solver:
                       fused_k, "tbptt" if tbptt else "second-order")
             fused_k = 1
 
-        for epoch in range(epochs):
-            for l in net.listeners:
-                if isinstance(l, TrainingListener):
-                    l.on_epoch_start(net)
-            # ETL timing (reference lastEtlTime, set in the fit loop
-            # MultiLayerNetwork.java:1130 and reported by
-            # PerformanceListener.java:111,178): with device prefetch the
-            # honest number is the time the consumer BLOCKED waiting for a
-            # device-resident batch (zero when the pipeline keeps up);
-            # without it, the gap between iterations spent fetching +
-            # host-preparing the batch.
-            _etl_t0 = time.perf_counter()
-            _etl_prev_total = 0.0
-            stream = (iter_windows(it_wrapped, fused_k) if fused_k > 1
-                      else it_wrapped)
-            for item in stream:
-                if prefetcher is not None:
-                    # delta of the cumulative wait covers both a single
-                    # batch and a K-batch window's worth of feed blocking.
-                    # When a windowed group falls back to bare batches,
-                    # the group's whole wait lands on its first batch
-                    # (iter_windows pulled all K before yielding) — lumpy
-                    # per-iteration attribution, correct epoch total.
-                    etl_ms = prefetcher.total_wait_ms - _etl_prev_total
-                    _etl_prev_total = prefetcher.total_wait_ms
-                else:
-                    etl_ms = (time.perf_counter() - _etl_t0) * 1e3
-                if isinstance(item, BatchWindow):
-                    k = len(item)
+        # Telemetry (telemetry/): structured fit -> epoch -> window|step ->
+        # dispatch spans plus iteration/window counters. Every span is pure
+        # host bookkeeping (two clock reads, one dict) — nothing here can
+        # add a device sync, and a disabled registry short-circuits to
+        # shared no-ops (pinned by the sync-freedom + overhead tier-1
+        # tests).
+        reg = get_registry()
+        with span("fit", epochs=epochs, steps_per_dispatch=fused_k,
+                  net=type(net).__name__):
+            for epoch in range(epochs):
+                with span("epoch", index=epoch):
+                    self._fit_epoch(net, it_wrapped, prefetcher, iterator,
+                                    dtype, base_rng, perf, fused_k, tbptt,
+                                    second_order, reg)
+        return net
+
+    def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, dtype,
+                   base_rng, perf, fused_k, tbptt, second_order, reg):
+        for l in net.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_start(net)
+        # ETL timing (reference lastEtlTime, set in the fit loop
+        # MultiLayerNetwork.java:1130 and reported by
+        # PerformanceListener.java:111,178): with device prefetch the
+        # honest number is the time the consumer BLOCKED waiting for a
+        # device-resident batch (zero when the pipeline keeps up);
+        # without it, the gap between iterations spent fetching +
+        # host-preparing the batch.
+        _etl_t0 = time.perf_counter()
+        _etl_prev_total = 0.0
+        # metric objects hoisted out of the loop: name->object resolution
+        # once per epoch, one lock-protected int add per iteration
+        _c_iters = reg.counter("train.iterations")
+        _c_windows = reg.counter("train.windows")
+        stream = (iter_windows(it_wrapped, fused_k) if fused_k > 1
+                  else it_wrapped)
+        for item in stream:
+            if prefetcher is not None:
+                # delta of the cumulative wait covers both a single
+                # batch and a K-batch window's worth of feed blocking.
+                # When a windowed group falls back to bare batches,
+                # the group's whole wait lands on its first batch
+                # (iter_windows pulled all K before yielding) — lumpy
+                # per-iteration attribution, correct epoch total.
+                etl_ms = prefetcher.total_wait_ms - _etl_prev_total
+                _etl_prev_total = prefetcher.total_wait_ms
+            else:
+                etl_ms = (time.perf_counter() - _etl_t0) * 1e3
+            if isinstance(item, BatchWindow):
+                k = len(item)
+                with span("window", k=k, iteration=net.iteration_count):
                     xs, ys, lms, fms = item.stacked(
                         cast=lambda a: _cast_features(a, dtype))
                     step_fn = self._get_window_step(lms is not None,
@@ -290,16 +313,22 @@ class Solver:
                         kwargs["lmasks"] = lms
                     if fms is not None:
                         kwargs["fmasks"] = fms
-                    net.params, net.state, net.opt_state, losses = step_fn(
-                        net.params, net.state, net.opt_state,
-                        jnp.asarray(net.iteration_count, jnp.int32),
-                        base_rng, xs, ys, **kwargs)
+                    with span("dispatch", k=k):
+                        net.params, net.state, net.opt_state, losses = \
+                            step_fn(net.params, net.state, net.opt_state,
+                                    jnp.asarray(net.iteration_count,
+                                                jnp.int32),
+                                    base_rng, xs, ys, **kwargs)
                     device_ms = max(
                         (time.perf_counter() - _etl_t0) * 1e3 - etl_ms, 0.0)
+                    _c_windows.inc()
+                    _c_iters.inc(k)
                     # per-step listener fan-out: losses[i] is a device
                     # slice — under the deferred-score protocol stock
                     # listeners read back only on their report/flush
                     # cycle, never per dispatched step
+                    for p in perf:
+                        p.note_window(k)
                     for i, ds in enumerate(item.datasets):
                         for p in perf:
                             p.note_batch(ds.num_examples(),
@@ -309,9 +338,15 @@ class Solver:
                             l.iteration_done(net, net.iteration_count,
                                              losses[i])
                         net.iteration_count += 1
-                    _etl_t0 = time.perf_counter()
-                    continue
-                ds = item
+                _etl_t0 = time.perf_counter()
+                continue
+            ds = item
+            # ONE span per single-step iteration (the step IS the dispatch
+            # here; a nested dispatch span would double the per-iteration
+            # telemetry cost on the dispatch-bound path for no extra
+            # attribution — the fused window branch keeps the window/
+            # dispatch pair because K steps amortize it)
+            with span("step", iteration=net.iteration_count):
                 x = _cast_any(ds.features, dtype)
                 y = _cast_any(ds.labels, dtype)
                 lmask = None if ds.labels_mask is None else _cast_any(ds.labels_mask, dtype)
@@ -321,7 +356,8 @@ class Solver:
                     # Solver dispatch, optimize/Solver.java:69-78)
                     loss = second_order.step(x, y, lmask, fmask)
                 elif tbptt:
-                    loss = self._fit_tbptt_batch(x, y, lmask, fmask, base_rng)
+                    loss = self._fit_tbptt_batch(x, y, lmask, fmask,
+                                                 base_rng)
                 else:
                     step_fn = self._get_step(lmask is not None, fmask is not None)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
@@ -332,7 +368,8 @@ class Solver:
                         kwargs["fmask"] = fmask
                     net.params, net.state, net.opt_state, loss = step_fn(
                         net.params, net.state, net.opt_state,
-                        jnp.asarray(net.iteration_count, jnp.int32), rng, x, y, **kwargs)
+                        jnp.asarray(net.iteration_count, jnp.int32),
+                        rng, x, y, **kwargs)
                 # listeners get the index of the last executed iteration
                 it_idx = net.iteration_count - 1 if tbptt else net.iteration_count
                 # device_ms: the iteration's wall time net of ETL wait —
@@ -341,6 +378,7 @@ class Solver:
                 # device time as the in-flight queue saturates)
                 device_ms = max(
                     (time.perf_counter() - _etl_t0) * 1e3 - etl_ms, 0.0)
+                _c_iters.inc()
                 for p in perf:
                     p.note_batch(ds.num_examples(), etl_wait_ms=etl_ms,
                                  device_ms=device_ms)
@@ -348,13 +386,16 @@ class Solver:
                     l.iteration_done(net, it_idx, loss)
                 if not tbptt:
                     net.iteration_count += 1
-                _etl_t0 = time.perf_counter()
-            for l in net.listeners:
-                if isinstance(l, TrainingListener):
-                    l.on_epoch_end(net)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-        return net
+            _etl_t0 = time.perf_counter()
+        for l in net.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_end(net)
+        if reg.enabled:
+            # device HBM watermark gauges, refreshed once per epoch (host
+            # API read; backends without memory_stats contribute nothing)
+            device_memory_gauges(reg)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
 
     def _pretrain_graph(self, iterator, epochs: int = 1):
         """ComputationGraph layerwise pretraining (reference

@@ -37,6 +37,7 @@ from ..datasets.dataset import AsyncDataSetIterator
 from ..datasets.prefetch import BatchWindow, DevicePrefetchIterator, iter_windows
 from ..optimize.listeners import PerformanceListener, TrainingListener
 from ..optimize.solver import cast_feed, train_step_math
+from ..telemetry import get_registry, span
 from .mesh import data_sharding, make_mesh, replicated, shard_map
 
 
@@ -288,44 +289,67 @@ class ParallelWrapper:
         def feed(v):
             return cast_feed(v, dtype, keep_ints=False)
 
-        for epoch in range(epochs):
-            for l in net.listeners:
-                if isinstance(l, TrainingListener):
-                    l.on_epoch_start(net)
-            if sync:
-                _t0 = time.perf_counter()
-                _etl_prev_total = 0.0
-                windowed = (self.steps_per_dispatch > 1
-                            and self.gradient_accumulator is None)
-                stream = (iter_windows(it_wrapped, self.steps_per_dispatch)
-                          if windowed else it_wrapped)
-                for item in stream:
-                    if prefetcher is not None:
-                        etl_ms = prefetcher.total_wait_ms - _etl_prev_total
-                        _etl_prev_total = prefetcher.total_wait_ms
-                    else:
-                        etl_ms = (time.perf_counter() - _t0) * 1e3
-                    if isinstance(item, BatchWindow):
-                        if self._sync_window_step is None:
-                            self._sync_window_step = \
-                                self._build_sync_window_step()
-                        k = len(item)
+        reg = get_registry()
+        with span("fit", epochs=epochs, mode=self.training_mode,
+                  devices=self.n, net="ParallelWrapper"):
+            for epoch in range(epochs):
+                with span("epoch", index=epoch):
+                    self._fit_epoch(net, it_wrapped, prefetcher, iterator,
+                                    feed, dtype, base_rng, perf, sync, reg)
+        return net
+
+    def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, feed, dtype,
+                   base_rng, perf, sync, reg):
+        for l in net.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_start(net)
+        if sync:
+            _t0 = time.perf_counter()
+            _etl_prev_total = 0.0
+            # hoisted like Solver._fit_epoch: metric name resolution once
+            # per epoch, one locked int add per iteration
+            _c_iters = reg.counter("train.iterations")
+            _c_windows = reg.counter("train.windows")
+            windowed = (self.steps_per_dispatch > 1
+                        and self.gradient_accumulator is None)
+            stream = (iter_windows(it_wrapped, self.steps_per_dispatch)
+                      if windowed else it_wrapped)
+            for item in stream:
+                if prefetcher is not None:
+                    etl_ms = prefetcher.total_wait_ms - _etl_prev_total
+                    _etl_prev_total = prefetcher.total_wait_ms
+                else:
+                    etl_ms = (time.perf_counter() - _t0) * 1e3
+                if isinstance(item, BatchWindow):
+                    if self._sync_window_step is None:
+                        self._sync_window_step = \
+                            self._build_sync_window_step()
+                    k = len(item)
+                    with span("window", k=k, iteration=net.iteration_count):
                         xs, ys, _, _ = item.stacked(cast=feed)
-                        (net.params, net.state, net.opt_state,
-                         losses) = self._sync_window_step(
-                            net.params, net.state, net.opt_state,
-                            jnp.asarray(net.iteration_count, jnp.int32),
-                            base_rng, xs, ys)
+                        with span("dispatch", k=k):
+                            (net.params, net.state, net.opt_state,
+                             losses) = self._sync_window_step(
+                                net.params, net.state, net.opt_state,
+                                jnp.asarray(net.iteration_count, jnp.int32),
+                                base_rng, xs, ys)
                         device_ms = max(
                             (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
+                        _c_windows.inc()
+                        _c_iters.inc(k)
+                        for p in perf:
+                            p.note_window(k)
                         for i, d in enumerate(item.datasets):
                             self._notify(perf, d, losses[i],
                                          etl_wait_ms=etl_ms / k,
                                          device_ms=device_ms / k)
                             net.iteration_count += 1
-                        _t0 = time.perf_counter()
-                        continue
-                    ds = item
+                    _t0 = time.perf_counter()
+                    continue
+                ds = item
+                # one span per single-step iteration (see Solver._fit_epoch:
+                # the step IS the dispatch on this path)
+                with span("step", iteration=net.iteration_count):
                     x = feed(ds.features)
                     y = feed(ds.labels)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
@@ -338,45 +362,54 @@ class ParallelWrapper:
                             net.params, net.state, net.opt_state,
                             self._acc_state, it, rng, x, y)
                     else:
-                        net.params, net.state, net.opt_state, loss = self._sync_step(
-                            net.params, net.state, net.opt_state, it, rng, x, y)
+                        net.params, net.state, net.opt_state, loss = \
+                            self._sync_step(net.params, net.state,
+                                            net.opt_state, it, rng, x, y)
                     device_ms = max(
                         (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
+                    _c_iters.inc()
                     self._notify(perf, ds, loss, etl_wait_ms=etl_ms,
                                  device_ms=device_ms)
                     net.iteration_count += 1
-                    _t0 = time.perf_counter()
-            else:
-                # accumulate K batches then run the fused K-step+average program
-                buf: List[Any] = []
-                for ds in it_wrapped:
-                    buf.append(ds)
-                    if len(buf) == self.averaging_frequency:
-                        self._run_avg(buf, base_rng, dtype, perf)
-                        buf = []
-                if buf:
+                _t0 = time.perf_counter()
+        else:
+            # accumulate K batches then run the fused K-step+average program
+            buf: List[Any] = []
+            for ds in it_wrapped:
+                buf.append(ds)
+                if len(buf) == self.averaging_frequency:
                     self._run_avg(buf, base_rng, dtype, perf)
-            for l in net.listeners:
-                if isinstance(l, TrainingListener):
-                    l.on_epoch_end(net)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-        return net
+                    buf = []
+            if buf:
+                self._run_avg(buf, base_rng, dtype, perf)
+        for l in net.listeners:
+            if isinstance(l, TrainingListener):
+                l.on_epoch_end(net)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
 
     def _run_avg(self, buf, base_rng, dtype, perf):
         net = self.net
-        xs = jnp.stack([jnp.asarray(np.asarray(d.features), dtype) for d in buf])
-        ys = jnp.stack([jnp.asarray(np.asarray(d.labels), dtype) for d in buf])
-        rng = jax.random.fold_in(base_rng, net.iteration_count)
-        step = self._avg_steps.get(len(buf))
-        if step is None:
-            step = self._avg_steps[len(buf)] = self._build_avg_step()
-        net.params, net.state, net.opt_state, loss = step(
-            net.params, net.state, net.opt_state,
-            jnp.asarray(net.iteration_count, jnp.int32), rng, xs, ys)
-        for d in buf:
-            self._notify(perf, d, loss)
-            net.iteration_count += 1
+        with span("window", k=len(buf), kind="averaging",
+                  iteration=net.iteration_count):
+            xs = jnp.stack([jnp.asarray(np.asarray(d.features), dtype) for d in buf])
+            ys = jnp.stack([jnp.asarray(np.asarray(d.labels), dtype) for d in buf])
+            rng = jax.random.fold_in(base_rng, net.iteration_count)
+            step = self._avg_steps.get(len(buf))
+            if step is None:
+                step = self._avg_steps[len(buf)] = self._build_avg_step()
+            with span("dispatch", k=len(buf)):
+                net.params, net.state, net.opt_state, loss = step(
+                    net.params, net.state, net.opt_state,
+                    jnp.asarray(net.iteration_count, jnp.int32), rng, xs, ys)
+            reg = get_registry()
+            reg.counter("train.windows").inc()
+            reg.counter("train.iterations").inc(len(buf))
+            for p in perf:
+                p.note_window(len(buf))
+            for d in buf:
+                self._notify(perf, d, loss)
+                net.iteration_count += 1
 
     def _notify(self, perf, ds, loss, etl_wait_ms: float = 0.0,
                 device_ms: float = 0.0):

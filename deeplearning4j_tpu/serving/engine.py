@@ -59,7 +59,7 @@ class InferenceEngine:
             raise ValueError(f"model '{name}' already registered "
                              "(use hot_swap to replace)")
         ladder = BucketLadder(buckets or self.buckets)
-        metrics = ServingMetrics()
+        metrics = ServingMetrics(name=name)
         ps = ProgramSet(net, feature_shape=feature_shape, ladder=ladder,
                         dtype=dtype or self.dtype, mesh=self.mesh,
                         data_axis=self.data_axis, forward_fn=forward_fn,

@@ -1,13 +1,19 @@
 """Serving observability: per-model latency/queue/occupancy/rejection
 counters + a process-wide XLA compile counter.
 
-The compile counter rides ``jax.monitoring`` (every backend compile emits a
-``/jax/core/compile/backend_compile_duration`` event) — it counts REAL XLA
-compilations anywhere in the process, so the zero-recompile-after-warm-up
-guarantee is asserted against the runtime itself, not against bookkeeping
-the engine could forget to do. Snapshots plug into the existing stats
-machinery via ``publish()`` (ui/storage.py StatsStorage contract — the same
-route StatsListener uses)."""
+Unified-telemetry migration (ISSUE 4): the compile counter and every
+recording below now ride the shared ``telemetry/`` layer. The counter is
+``telemetry.xla_compile_count`` — ONE ``jax.monitoring`` fan-out for the
+whole process (every backend compile emits a
+``/jax/core/compile/backend_compile_duration`` event), so the
+zero-recompile-after-warm-up guarantee is still asserted against the
+runtime itself, not bookkeeping the engine could forget to do — and each
+``ServingMetrics`` recording is mirrored into the process registry as
+``serving.<model>.*`` histograms/counters, putting training and serving
+on ONE reporting surface (Prometheus dump, dashboard card, StatsStorage
+bridge). The local snapshot() dict — the ``GET /metrics`` payload — is
+byte-compatible with the pre-migration format.
+"""
 from __future__ import annotations
 
 import threading
@@ -15,53 +21,31 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_compile_count = 0
-_counter_installed = False
-_install_lock = threading.Lock()
-
-
-def _install_compile_counter() -> None:
-    global _counter_installed
-    with _install_lock:
-        if _counter_installed:
-            return
-        import jax.monitoring
-
-        def _on_duration(name, secs, **kw):
-            global _compile_count
-            if name == _BACKEND_COMPILE_EVENT:
-                _compile_count += 1
-
-        # jax 0.4.x has register but no unregister for a single listener;
-        # one increment-only listener installed once per process is inert.
-        jax.monitoring.register_event_duration_secs_listener(_on_duration)
-        _counter_installed = True
+from ..telemetry import get_registry
+from ..telemetry import xla_compile_count as _telemetry_compile_count
+from ..telemetry.registry import _percentile
 
 
 def xla_compile_count() -> int:
-    """Process-wide XLA backend-compile count. Take a snapshot after
-    warm-up; any later increase means something recompiled."""
-    _install_compile_counter()
-    return _compile_count
-
-
-def _percentile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+    """Process-wide XLA backend-compile count (delegates to the telemetry
+    fan-out). Take a snapshot after warm-up; any later increase means
+    something recompiled."""
+    return _telemetry_compile_count()
 
 
 class ServingMetrics:
     """Per-model counters. Latency percentiles come from a bounded ring of
     the most recent ``window`` observations (enough for stable p99 at
-    serving rates without unbounded memory)."""
+    serving rates without unbounded memory). Every recording is mirrored
+    into the shared telemetry registry under ``serving.<name>.*``."""
 
-    def __init__(self, window: int = 4096):
+    def __init__(self, window: int = 4096, name: str = "default",
+                 registry=None):
         self._lock = threading.Lock()
         self._lat_ms = deque(maxlen=window)
         self._qwait_ms = deque(maxlen=window)
+        self.name = name
+        self._registry = registry
         self.requests = 0
         self.rows = 0
         self.batches = 0
@@ -73,16 +57,30 @@ class ServingMetrics:
         self.swaps = 0
         self._t0 = time.monotonic()
 
+    @property
+    def registry(self):
+        # resolved per recording so a test-swapped global registry applies
+        return self._registry if self._registry is not None else get_registry()
+
     # ------------------------------------------------------------- recording
     def record_request(self, latency_ms: float, rows: int) -> None:
         with self._lock:
             self.requests += 1
             self.rows += rows
             self._lat_ms.append(latency_ms)
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"serving.{self.name}.requests").inc()
+            reg.counter(f"serving.{self.name}.rows").inc(rows)
+            reg.histogram(f"serving.{self.name}.latency_ms").observe(latency_ms)
 
     def record_queue_wait(self, queue_wait_ms: float) -> None:
         with self._lock:
             self._qwait_ms.append(queue_wait_ms)
+        reg = self.registry
+        if reg.enabled:
+            reg.histogram(
+                f"serving.{self.name}.queue_wait_ms").observe(queue_wait_ms)
 
     def record_batch(self, bucket: int, rows: int) -> None:
         with self._lock:
@@ -90,14 +88,26 @@ class ServingMetrics:
             self.batch_rows += rows
             self.padded_rows += bucket - rows
             self.per_bucket[bucket] = self.per_bucket.get(bucket, 0) + 1
+            dispatched = self.batch_rows + self.padded_rows
+            occupancy = self.batch_rows / dispatched if dispatched else 0.0
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"serving.{self.name}.batches").inc()
+            reg.gauge(f"serving.{self.name}.batch_occupancy").set(occupancy)
 
     def record_rejection(self, kind: str) -> None:
         with self._lock:
             self.rejected[kind] = self.rejected.get(kind, 0) + 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"serving.{self.name}.rejected.{kind}").inc()
 
     def record_swap(self) -> None:
         with self._lock:
             self.swaps += 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"serving.{self.name}.hot_swaps").inc()
 
     # ------------------------------------------------------------- reporting
     def snapshot(self) -> dict:

@@ -1,0 +1,43 @@
+"""Unified telemetry: structured spans, runtime counters, and
+recompile/host-sync detectors across training, the input pipeline, and
+serving.
+
+One process-wide, thread-safe :class:`MetricsRegistry` (counters, gauges,
+histograms with p50/p95/p99) plus :func:`span` — a context manager
+producing structured, nested spans exported as Chrome-trace JSON
+(Perfetto-loadable, ``write_chrome_trace``), a Prometheus-style text dump
+(``to_prometheus_text``) and a bridge into the existing StatsStorage /
+dashboard SPI (``publish``). JAX-native signal capture attributes backend
+compiles to the active span (:class:`RecompileDetector`), flags
+accidental device->host readbacks (:class:`HostSyncDetector`) and
+snapshots device memory watermarks (:func:`device_memory_gauges`).
+
+Built-in instrumentation reports here from ``Solver``/``MultiLayerNetwork``
+/``ComputationGraph.fit`` (fit/epoch/window/dispatch spans),
+``DevicePrefetchIterator`` (queue depth, ship latency, stall time),
+``ParallelWrapper``, ``PerformanceListener`` and the ``serving/`` engine —
+disable it all with ``get_registry().enabled = False`` (a near-no-op; the
+``telemetry_overhead_pct`` bench row guards <5% enabled overhead on a
+dispatch-bound loop).
+"""
+from .jaxsignals import (HostSyncDetector, HostSyncError, RecompileDetector,
+                         device_memory_gauges, ensure_monitoring_hook,
+                         xla_compile_count)
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry, set_registry)
+from .spans import Span, current_span, current_span_path, span
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "get_registry", "set_registry",
+    "Span", "span", "current_span", "current_span_path",
+    "RecompileDetector", "HostSyncDetector", "HostSyncError",
+    "device_memory_gauges", "xla_compile_count", "ensure_monitoring_hook",
+    "reset",
+]
+
+
+def reset() -> None:
+    """Clear the global registry's metrics and trace buffer (tests /
+    between runs). The enabled flag is preserved."""
+    get_registry().reset()

@@ -1,0 +1,317 @@
+"""JAX-native telemetry signals: recompiles, host syncs, device memory.
+
+Three runtime betrayals the compiler never announces loudly enough:
+
+- **Silent retraces/recompiles.** Every XLA backend compile emits a
+  ``/jax/core/compile/backend_compile_duration`` event on
+  ``jax.monitoring``. ONE process-wide fan-out listener (jax 0.4.x has no
+  unregister, so it is installed once and dispatches to subscribers)
+  counts them, lands a Chrome-trace event attributed to the compiling
+  thread's active span path, and feeds any live ``RecompileDetector`` —
+  which turns "training got slow" into "iteration 14 recompiled inside
+  fit/epoch/window/dispatch".
+
+- **Accidental host syncs.** A ``float(loss)`` in the wrong place
+  serializes the whole async dispatch pipeline. ``HostSyncDetector``
+  wraps the jax array host-materialization funnel
+  (``ArrayImpl._value`` — the path ``float()``/``bool()``/``str()``/
+  ``.tolist()``/printing take on EVERY backend, including the CPU test
+  platform where XLA's transfer guard is a no-op because host arrays are
+  zero-copy) and flags each first materialization inside the armed scope
+  with the offending span path. On real device backends pass
+  ``transfer_guard="disallow"`` to additionally arm
+  ``jax.transfer_guard_device_to_host`` for the copies the Python funnel
+  cannot see (``np.asarray``/``device_get`` go through C).
+
+- **Device memory.** ``device_memory_gauges`` snapshots
+  ``Device.memory_stats()`` into ``device<i>.bytes_in_use`` /
+  ``peak_bytes_in_use`` gauges (watermark kept by the Gauge itself).
+  CPU backends report no stats; the gauges simply stay absent there.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .registry import MetricsRegistry, get_registry
+from .spans import _EPOCH_NS, current_span_path
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["xla_compile_count", "ensure_monitoring_hook",
+           "RecompileDetector", "HostSyncDetector", "HostSyncError",
+           "device_memory_gauges"]
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_hook_lock = threading.Lock()
+_hook_installed = False
+_compile_count = 0
+_compile_subscribers: List[Callable[[str, float], None]] = []
+
+
+def ensure_monitoring_hook() -> None:
+    """Install the process-wide jax.monitoring fan-out (idempotent)."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    with _hook_lock:
+        if _hook_installed:
+            return
+        import jax.monitoring
+
+        def _on_duration(name, secs, **kw):
+            global _compile_count
+            if name != _BACKEND_COMPILE_EVENT:
+                return
+            _compile_count += 1
+            path = current_span_path()
+            reg = get_registry()
+            if reg.enabled:
+                reg.counter("jax.compiles").inc()
+                reg.histogram("jax.compile_ms").observe(secs * 1e3)
+                # synthesized complete event: the listener fires when the
+                # compile FINISHES, so backdate the start by its duration
+                now_ns = time.perf_counter_ns()
+                reg.record_event({
+                    "name": "backend_compile", "ph": "X", "cat": "compile",
+                    "ts": (now_ns + _EPOCH_NS) // 1000 - int(secs * 1e6),
+                    "dur": int(secs * 1e6), "pid": 1,
+                    "tid": threading.get_ident() & 0xFFFFFFFF,
+                    "args": {"path": path, "duration_s": round(secs, 6)}})
+            for cb in list(_compile_subscribers):
+                cb(path, secs)
+
+        # jax 0.4.x registers but cannot unregister a listener; one
+        # fan-out installed once per process dispatches to subscribers.
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _hook_installed = True
+
+
+def xla_compile_count() -> int:
+    """Process-wide XLA backend-compile count (the zero-recompile
+    assertions in serving ride this — snapshot after warm-up, any later
+    increase means something recompiled)."""
+    ensure_monitoring_hook()
+    return _compile_count
+
+
+class RecompileDetector:
+    """Scoped recompile watchdog: counts backend compiles while armed and
+    attributes each to the active span path of the compiling thread.
+
+        with RecompileDetector(allowed=0) as det:
+            serve_steady_state_traffic()
+        det.count            # compiles observed in scope
+        det.events           # [{"span_path", "duration_s", "wall_time"}]
+
+    ``allowed`` compiles (warm-up budget) pass silently; every compile
+    beyond it logs a WARNING naming the offending span path, the signal
+    PR 3's test-only counter could not give: *where* the retrace happened.
+    """
+
+    def __init__(self, *, allowed: int = 0, warn: bool = True,
+                 registry: Optional[MetricsRegistry] = None):
+        self.allowed = allowed
+        self.warn = warn
+        self.registry = registry or get_registry()
+        self.count = 0
+        self.events: List[dict] = []
+        self._armed = False
+
+    def _on_compile(self, span_path: str, secs: float) -> None:
+        self.count += 1
+        self.events.append({"span_path": span_path,
+                            "duration_s": round(secs, 6),
+                            "wall_time": time.time()})
+        if self.registry.enabled:
+            self.registry.counter("jax.recompiles_flagged").inc()
+        if self.warn and self.count > self.allowed:
+            log.warning(
+                "RecompileDetector: backend compile #%d (%.1f ms) during "
+                "span '%s' — a steady-state loop should not trace; check "
+                "for shape/dtype instability or un-jitted host control "
+                "flow", self.count, secs * 1e3, span_path or "<no span>")
+
+    def __enter__(self) -> "RecompileDetector":
+        ensure_monitoring_hook()
+        if not self._armed:
+            _compile_subscribers.append(self._on_compile)
+            self._armed = True
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._armed:
+            try:
+                _compile_subscribers.remove(self._on_compile)
+            except ValueError:
+                pass
+            self._armed = False
+        return False
+
+    @property
+    def recompiles(self) -> int:
+        """Compiles beyond the allowed (warm-up) budget."""
+        return max(0, self.count - self.allowed)
+
+
+class HostSyncError(RuntimeError):
+    """Raised by HostSyncDetector(action="raise") at the sync site."""
+
+
+_sync_lock = threading.Lock()
+_sync_installed = False
+_sync_detectors: List["HostSyncDetector"] = []
+
+
+def _install_sync_tripwire() -> None:
+    """Wrap ArrayImpl._value (idempotent, installed once per process).
+
+    ``_value`` is the single host-materialization funnel for implicit
+    readbacks: ``float()``, ``bool()``, ``str()``, ``.tolist()``,
+    iteration, printing. The wrapper costs one list check when no
+    detector is armed. Only the FIRST materialization of a buffer goes
+    through (jax caches ``_npy_value``) — which is exactly the event that
+    blocks on the device; cached re-reads are free and stay unflagged.
+    """
+    global _sync_installed
+    if _sync_installed:
+        return
+    with _sync_lock:
+        if _sync_installed:
+            return
+        from jax._src import array as _jarray
+        orig = _jarray.ArrayImpl._value
+        fget = orig.fget if isinstance(orig, property) else None
+        if fget is None:          # unexpected jax internals: stay inert
+            log.warning(
+                "HostSyncDetector: ArrayImpl._value is not a property on "
+                "this jax version — the readback tripwire cannot install, "
+                "detectors will report zero syncs (transfer_guard= still "
+                "works on device backends)")
+            _sync_installed = True
+            return
+
+        def _traced_value(self):
+            # _npy_value set => already materialized on a previous read:
+            # this access is a host-cache hit, not a device sync
+            if _sync_detectors and getattr(self, "_npy_value", None) is None:
+                tid = threading.get_ident()
+                for det in list(_sync_detectors):
+                    det._on_sync(self, tid)
+            return fget(self)
+
+        _jarray.ArrayImpl._value = property(_traced_value)
+        _sync_installed = True
+
+
+class HostSyncDetector:
+    """Scoped device->host readback tripwire.
+
+        with HostSyncDetector() as det:          # action="warn"
+            fit_window()
+        assert det.count == 0
+
+    ``action``: "count" (silent), "warn" (log WARNING with the span path
+    and array shape), or "raise" (HostSyncError at the sync site — the
+    hard mode for pinning a fused scan window sync-free in CI).
+    ``thread_only=True`` (default) scopes detection to the arming thread,
+    so a serving worker's legitimate readbacks on another thread don't
+    trip a detector armed around a training loop.
+    ``transfer_guard`` optionally arms jax's own d2h transfer guard with
+    the given mode for the scope (real accelerator backends only — it is
+    a no-op on the zero-copy CPU platform).
+    """
+
+    def __init__(self, *, action: str = "warn", thread_only: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 transfer_guard: Optional[str] = None):
+        if action not in ("count", "warn", "raise"):
+            raise ValueError(f"unknown action {action!r}")
+        self.action = action
+        self.thread_only = thread_only
+        self.registry = registry or get_registry()
+        self.transfer_guard = transfer_guard
+        self.count = 0
+        self.events: List[dict] = []
+        self._tid = None
+        self._guard_cm = None
+
+    # called from the _value wrapper, possibly on any thread
+    def _on_sync(self, arr, tid: int) -> None:
+        if self.thread_only and tid != self._tid:
+            return
+        path = current_span_path()
+        try:
+            shape = tuple(arr.shape)
+        except Exception:
+            shape = ()
+        self.count += 1
+        self.events.append({"span_path": path, "shape": shape,
+                            "wall_time": time.time()})
+        reg = self.registry
+        if reg.enabled:
+            reg.counter("jax.host_syncs_flagged").inc()
+            reg.record_event({
+                "name": "host_sync", "ph": "i", "cat": "sync", "s": "t",
+                "ts": (time.perf_counter_ns() + _EPOCH_NS) // 1000,
+                "pid": 1, "tid": tid & 0xFFFFFFFF,
+                "args": {"path": path, "shape": str(shape)}})
+        if self.action == "warn":
+            log.warning(
+                "HostSyncDetector: device->host readback of shape %s "
+                "during span '%s' — this blocks the async dispatch "
+                "pipeline; defer the readback (score_to_float protocol) "
+                "or move it off the hot path", shape, path or "<no span>")
+        elif self.action == "raise":
+            raise HostSyncError(
+                f"unexpected device->host readback (shape {shape}) during "
+                f"span '{path or '<no span>'}'")
+
+    def __enter__(self) -> "HostSyncDetector":
+        _install_sync_tripwire()
+        self._tid = threading.get_ident()
+        with _sync_lock:
+            _sync_detectors.append(self)
+        if self.transfer_guard is not None:
+            import jax
+            self._guard_cm = jax.transfer_guard_device_to_host(
+                self.transfer_guard)
+            self._guard_cm.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        with _sync_lock:
+            try:
+                _sync_detectors.remove(self)
+            except ValueError:
+                pass
+        if self._guard_cm is not None:
+            self._guard_cm.__exit__(*exc)
+            self._guard_cm = None
+        return False
+
+
+def device_memory_gauges(registry: Optional[MetricsRegistry] = None
+                         ) -> Dict[str, float]:
+    """Snapshot per-device memory stats into ``device<i>.bytes_in_use`` /
+    ``device<i>.peak_bytes_in_use`` gauges. Returns the values read;
+    backends without memory_stats (CPU) contribute nothing."""
+    import jax
+    reg = registry or get_registry()
+    out: Dict[str, float] = {}
+    for i, dev in enumerate(jax.local_devices()):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                name = f"device{i}.{key}"
+                reg.gauge(name).set(float(stats[key]))
+                out[name] = float(stats[key])
+    return out

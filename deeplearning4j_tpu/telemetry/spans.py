@@ -1,0 +1,158 @@
+"""Structured spans: nested wall-clock attribution for the hot paths.
+
+``span(name, **attrs)`` opens a structured span on a thread-local stack;
+on close it lands ONE Chrome-trace complete event ("ph": "X") in the
+registry's trace buffer and one observation in the ``span.<name>_ms``
+histogram. Nesting is the stack: a span opened inside another carries the
+parent's path, so a trace of ``fit -> epoch -> window -> dispatch`` nests
+in Perfetto exactly as the loop nests in code, and the jax signal hooks
+(jaxsignals.py) attribute backend compiles to ``current_span_path()`` of
+the compiling thread.
+
+Sync-freedom: a span records two ``perf_counter_ns`` reads and a couple
+of dict writes — it never touches a device value, so instrumenting the
+dispatch loop cannot serialize it (the tier-1 sync-freedom test pins
+this). When the registry is disabled, ``span()`` returns a shared no-op
+context manager: one attribute check, zero allocation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = ["Span", "span", "current_span", "current_span_path"]
+
+# Chrome-trace timestamps are microseconds; anchor perf_counter_ns to the
+# unix epoch once so every event in a process shares one clock domain.
+_EPOCH_NS = time.time_ns() - time.perf_counter_ns()
+
+_tls = threading.local()
+
+
+def _stack() -> List["Span"]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class _NoopSpan:
+    """Shared do-nothing span for a disabled registry."""
+
+    __slots__ = ()
+    name = path = "<disabled>"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def start(self):
+        return self
+
+    def end(self):
+        return self
+
+    def set_attr(self, key, value):
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One timed, attributed region. Context-manager use is the norm;
+    ``start()``/``end()`` exist for regions that do not nest lexically
+    (e.g. ProfilerListener's capture window opens in one listener callback
+    and closes in a later one)."""
+
+    __slots__ = ("name", "attrs", "path", "registry", "_t0", "_tid",
+                 "_ended")
+
+    def __init__(self, name: str, registry: MetricsRegistry, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.registry = registry
+        self.path = name          # parent path resolved at start()
+        self._t0 = 0
+        self._tid = 0
+        self._ended = False
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "Span":
+        stack = _stack()
+        if stack:
+            self.path = stack[-1].path + "/" + self.name
+        stack.append(self)
+        self._tid = threading.get_ident() & 0xFFFFFFFF
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def end(self) -> "Span":
+        t1 = time.perf_counter_ns()
+        if self._ended:
+            return self
+        self._ended = True
+        stack = _stack()
+        # the common case is LIFO exit; tolerate out-of-order manual end()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:
+            while stack and stack.pop() is not self:
+                pass
+        dur_ns = t1 - self._t0
+        reg = self.registry
+        if reg.enabled:
+            args = self.attrs
+            args["path"] = self.path
+            reg.record_event({"name": self.name, "ph": "X", "cat": "span",
+                              "ts": (self._t0 + _EPOCH_NS) // 1000,
+                              "dur": dur_ns // 1000,
+                              "pid": 1, "tid": self._tid, "args": args})
+            reg.histogram("span." + self.name + "_ms").observe(dur_ns / 1e6)
+        return self
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+_hook_ready = False
+
+
+def span(name: str, **attrs):
+    """Open a structured span (context manager). ``attrs`` must be
+    host-side values (ints/strs) — passing a device array would force the
+    readback this layer exists to avoid."""
+    reg = get_registry()
+    if not reg.enabled:
+        return _NOOP
+    global _hook_ready
+    if not _hook_ready:
+        from . import jaxsignals
+        jaxsignals.ensure_monitoring_hook()   # compiles attribute to spans
+        _hook_ready = True
+    return Span(name, reg, attrs)
+
+
+def current_span() -> Optional[Span]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def current_span_path() -> str:
+    """'fit/epoch/window/dispatch'-style path of the innermost open span on
+    THIS thread ('' outside any span) — the attribution key the recompile
+    and host-sync detectors report."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1].path if stack else ""

@@ -40,6 +40,7 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>{t_throughput}</h2>{speed_chart}</div>
 <div class="card"><h2>{t_parammag}</h2>{param_chart}</div>
 <div class="card"><h2>{t_ratio}</h2>{ratio_chart}</div>
+{telemetry_card}
 {hist_cards}
 {activation_cards}
 {graph_card}
@@ -79,6 +80,53 @@ def _svg_histogram(hist: dict, width=340, height=120):
         lower_bounds=[lo + i * w for i in range(n)],
         upper_bounds=[lo + (i + 1) * w for i in range(n)],
         y=[float(c) for c in counts], width=width, height=height).render()
+
+
+def _render_telemetry_card(title: str) -> str:
+    """Runtime-telemetry card from the process-wide telemetry registry
+    (telemetry/): recompile count, prefetch stall, serving p99 and the
+    rest of the counters/gauges/span histograms — rendered on the train
+    overview so existing TrainingUIServer users see the new signals with
+    zero code changes. Empty registry (or disabled telemetry) renders
+    nothing."""
+    from ..telemetry import get_registry
+    snap = get_registry().snapshot()
+    counters, gauges = snap["counters"], snap["gauges"]
+    hists = snap["histograms"]
+    if not (counters or gauges or hists):
+        return ""
+    # headline signals first: the three the tentpole names
+    headline = []
+    if "jax.compiles" in counters:
+        headline.append(("XLA compiles", counters["jax.compiles"]))
+    pw = hists.get("prefetch.wait_ms")
+    if pw:
+        headline.append(("prefetch stall p95 (ms)", round(pw["p95"], 3)))
+    for name, h in sorted(hists.items()):
+        if name.startswith("serving.") and name.endswith(".latency_ms"):
+            model = name[len("serving."):-len(".latency_ms")]
+            headline.append((f"serving p99 [{model}] (ms)",
+                             round(h["p99"], 3)))
+    rows = "".join(
+        f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
+        for k, v in headline)
+    rows += "".join(
+        f"<tr><th>{html.escape(n)}</th><td>{v}</td></tr>"
+        for n, v in sorted(counters.items()))
+    rows += "".join(
+        f"<tr><th>{html.escape(n)}</th><td>{round(g['value'], 4)}"
+        f" <span class='meta'>(max {round(g['max'], 4)})</span></td></tr>"
+        for n, g in sorted(gauges.items()))
+    hrows = "".join(
+        f"<tr><th>{html.escape(n)}</th><td>{round(h['p50'], 3)}</td>"
+        f"<td>{round(h['p95'], 3)}</td><td>{round(h['p99'], 3)}</td>"
+        f"<td>{h['count']}</td></tr>"
+        for n, h in sorted(hists.items()))
+    hist_table = (
+        "<table><tr><th></th><th>p50</th><th>p95</th><th>p99</th>"
+        "<th>count</th></tr>" + hrows + "</table>") if hrows else ""
+    return (f"<div class='card'><h2>{title}</h2>"
+            f"<table>{rows}</table>{hist_table}</div>")
 
 
 def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,
@@ -223,6 +271,7 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
         speed_chart=_svg_line_chart([("it/s", speed_pts)]),
         param_chart=_svg_line_chart(param_series),
         ratio_chart=_svg_line_chart(ratio_series),
+        telemetry_card=_render_telemetry_card(m("train.telemetry")),
         hist_cards=hist_cards,
         activation_cards=activation_cards,
         graph_card=graph_card,

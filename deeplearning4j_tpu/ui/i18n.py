@@ -30,6 +30,7 @@ _EN = {
     "train.activations": "Convolutional activations",
     "train.graph": "Model graph",
     "train.nodata": "no data yet",
+    "train.telemetry": "Runtime telemetry",
 }
 
 _MESSAGES: Dict[str, Dict[str, str]] = {
@@ -49,6 +50,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.activations": "Konvolutions-Aktivierungen",
         "train.graph": "Modellgraph",
         "train.nodata": "noch keine Daten",
+        "train.telemetry": "Laufzeit-Telemetrie",
     },
     "ja": {
         "train.pagetitle": "トレーニング概要",
@@ -65,6 +67,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.activations": "畳み込み活性化",
         "train.graph": "モデルグラフ",
         "train.nodata": "データなし",
+        "train.telemetry": "ランタイムテレメトリ",
     },
     "ko": {
         "train.pagetitle": "훈련 개요",
@@ -81,6 +84,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.activations": "합성곱 활성화",
         "train.graph": "모델 그래프",
         "train.nodata": "데이터 없음",
+        "train.telemetry": "런타임 텔레메트리",
     },
     "ru": {
         "train.pagetitle": "Обзор обучения",
@@ -97,6 +101,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.activations": "Свёрточные активации",
         "train.graph": "Граф модели",
         "train.nodata": "данных пока нет",
+        "train.telemetry": "Телеметрия выполнения",
     },
     "zh": {
         "train.pagetitle": "训练概览",
@@ -113,6 +118,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.activations": "卷积激活",
         "train.graph": "模型图",
         "train.nodata": "暂无数据",
+        "train.telemetry": "运行时遥测",
     },
 }
 

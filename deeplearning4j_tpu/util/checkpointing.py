@@ -9,6 +9,7 @@ zip, TPU-preemption style).
 """
 from __future__ import annotations
 
+import logging
 import os
 import re
 import tempfile
@@ -16,6 +17,8 @@ from typing import Any, List, Optional
 
 from ..optimize.listeners import TrainingListener
 from .serialization import restore_model, write_model
+
+_log = logging.getLogger("deeplearning4j_tpu")
 
 _CKPT_RE = re.compile(r"^checkpoint_epoch(\d+)\.zip$")
 
@@ -115,7 +118,18 @@ class ProfilerListener(TrainingListener):
     """XProf/TensorBoard trace capture for a window of iterations (SURVEY.md
     §5.1: the reference has PerformanceListener throughput only; the TPU
     build hooks jax.profiler so kernel-level traces land in ``log_dir``,
-    viewable with xprof/tensorboard)."""
+    viewable with xprof/tensorboard).
+
+    The captured window is also bracketed by a telemetry span
+    (``profiler_capture``), so the Chrome-trace timeline shows WHERE in the
+    fit/epoch structure the kernel-level capture happened, and
+    ``start_trace`` failures are tolerated: jax.profiler allows only one
+    active trace per process, so a second profiler (another listener, an
+    outer ``jax.profiler.trace`` block) used to raise out of
+    ``iteration_done`` — killing the fit — and left this listener believing
+    no trace was active while one was. Now the failed start is logged, the
+    listener retires itself cleanly (``_done``), and the training loop is
+    untouched."""
 
     def __init__(self, log_dir: str, start_iteration: int = 10,
                  n_iterations: int = 5):
@@ -124,23 +138,46 @@ class ProfilerListener(TrainingListener):
         self.end_iteration = start_iteration + n_iterations
         self._active = False
         self._done = False
+        self._span = None
+
+    def _stop(self, jax):
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:      # a dead/foreign trace must not kill fit
+            _log.warning("ProfilerListener: stop_trace failed (%s)", e)
+        if self._span is not None:
+            self._span.end()
+            self._span = None
+        self._active = False
+        self._done = True
 
     def iteration_done(self, model, iteration, score):
         import jax
         if self._done:
             return
         if not self._active and iteration >= self.start_iteration:
-            jax.profiler.start_trace(self.log_dir)
+            try:
+                jax.profiler.start_trace(self.log_dir)
+            except Exception as e:
+                # e.g. another trace is already active (jax allows one per
+                # process): give up cleanly instead of breaking the fit
+                # loop and lying about _active state
+                _log.warning(
+                    "ProfilerListener: start_trace failed (%s); skipping "
+                    "this capture window", e)
+                self._done = True
+                return
+            from ..telemetry import span
+            self._span = span("profiler_capture", log_dir=self.log_dir,
+                              start_iteration=iteration,
+                              n_iterations=self.end_iteration
+                              - self.start_iteration).start()
             self._active = True
         elif self._active and iteration >= self.end_iteration:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self._stop(jax)
 
     def on_epoch_end(self, model):
         # never leak an open trace across a short run
         if self._active:
             import jax
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self._stop(jax)

@@ -413,13 +413,23 @@ def test_dispatch_bound_bench_smoke():
     and the scan-fused column must not be catastrophically slower than
     per-step dispatch (a broken fused path shows up here long before a
     BENCH_* round). The >=2x acceptance number is measured by bench.py on
-    the real rig; CI only pins 'not broken'."""
+    the real rig; CI only pins 'not broken'.
+
+    Robustness: a shared CI box can stall a single 32-step epoch for
+    >100ms (scheduler/GC), which at repeats=1 tanked the ratio below the
+    bound in otherwise-healthy runs — so each attempt takes best-of-3
+    epochs per mode, and only three consecutive failing attempts fail
+    the guard (a genuinely broken fused path fails every attempt)."""
     import os
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
-    row = bench.bench_dispatch_bound(steps=32, ks=(1, 4), repeats=1)
-    assert row["k1_steps_per_sec"] > 0
-    assert row["k4_steps_per_sec"] > 0
-    assert row["fused_speedup"] > 0.5, row
+    row = None
+    for _ in range(3):
+        row = bench.bench_dispatch_bound(steps=32, ks=(1, 4), repeats=3)
+        assert row["k1_steps_per_sec"] > 0
+        assert row["k4_steps_per_sec"] > 0
+        if row["fused_speedup"] > 0.5:
+            return
+    pytest.fail(f"fused path catastrophically slow in 3 attempts: {row}")

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Fold a telemetry Chrome-trace JSONL into a per-phase time table.
+
+    python tools/trace2summary.py trace.json [--by-path] [--top N]
+
+Reads the trace written by ``telemetry.MetricsRegistry.write_chrome_trace``
+(one event per line inside a JSON array; bare JSONL — one object per line,
+no brackets — is accepted too) and prints per-phase totals:
+
+    phase                           count    total_ms     mean_ms      p95_ms  share
+    fit/epoch/window/dispatch          32      412.10       12.88       14.02  61.3%
+    ...
+
+``--by-path`` groups by the full span path (the default); ``--by-name``
+groups by span name only (all ``dispatch`` spans together regardless of
+where they nest). "share" is each phase's total over the trace's wall
+span — nested phases overlap their parents, so shares can sum past 100%:
+the table answers "where does wall-clock go at each level", not "what
+partitions it". Compile events (cat=compile) fold in like spans, so a
+retrace-heavy run shows its compile tax as a phase.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    """Chrome-trace JSON array OR bare JSONL (one event object per line)."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.strip()
+    if not stripped:
+        return []
+    try:
+        data = json.loads(stripped)
+        return data if isinstance(data, list) else [data]
+    except json.JSONDecodeError:
+        events = []
+        for line in stripped.splitlines():
+            line = line.strip().rstrip(",")
+            if line in ("", "[", "]"):
+                continue
+            events.append(json.loads(line))
+        return events
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    # deliberate local copy of telemetry.registry._percentile (same
+    # nearest-rank convention): this CLI must stay importable without
+    # pulling in the package (and with it jax)
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(events: List[dict], by: str = "path") -> List[dict]:
+    """[{phase, count, total_ms, mean_ms, p95_ms, share}] sorted by
+    total_ms descending. ``by``: "path" (nested span path) or "name"."""
+    complete = [e for e in events if e.get("ph") == "X"]
+    groups: Dict[str, List[float]] = {}
+    for e in complete:
+        name = e.get("name", "?")
+        if by == "path":
+            key = e.get("args", {}).get("path") or name
+            # a non-span event (e.g. a backend_compile attributed to the
+            # span it happened under) gets its own bucket beneath that
+            # span's path instead of inflating the span's numbers
+            if e.get("cat", "span") != "span":
+                key = f"{key}/[{name}]" if key != name else f"[{name}]"
+        else:
+            key = name
+        groups.setdefault(key, []).append(e.get("dur", 0) / 1e3)
+    if not complete:
+        return []
+    t0 = min(e["ts"] for e in complete)
+    t1 = max(e["ts"] + e.get("dur", 0) for e in complete)
+    wall_ms = max((t1 - t0) / 1e3, 1e-9)
+    rows = []
+    for phase, durs in groups.items():
+        total = sum(durs)
+        rows.append({"phase": phase, "count": len(durs),
+                     "total_ms": round(total, 3),
+                     "mean_ms": round(total / len(durs), 3),
+                     "p95_ms": round(_percentile(sorted(durs), 0.95), 3),
+                     "share": round(total / wall_ms, 4)})
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def format_table(rows: List[dict]) -> str:
+    if not rows:
+        return "(no complete events in trace)"
+    w = max(len(r["phase"]) for r in rows)
+    w = max(w, len("phase"))
+    head = (f"{'phase':<{w}}  {'count':>7}  {'total_ms':>10}  "
+            f"{'mean_ms':>9}  {'p95_ms':>9}  {'share':>6}")
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(f"{r['phase']:<{w}}  {r['count']:>7}  "
+                     f"{r['total_ms']:>10.2f}  {r['mean_ms']:>9.3f}  "
+                     f"{r['p95_ms']:>9.3f}  {r['share']:>6.1%}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fold a telemetry Chrome trace into per-phase totals")
+    ap.add_argument("trace", help="trace file (JSON array or JSONL)")
+    group = ap.add_mutually_exclusive_group()
+    group.add_argument("--by-path", dest="by", action="store_const",
+                       const="path", default="path",
+                       help="group by full span path (default)")
+    group.add_argument("--by-name", dest="by", action="store_const",
+                       const="name", help="group by span name only")
+    ap.add_argument("--top", type=int, default=0,
+                    help="show only the N largest phases")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    rows = summarize(load_events(args.trace), by=args.by)
+    if args.top:
+        rows = rows[:args.top]
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

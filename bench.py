@@ -101,13 +101,25 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    collective dispatch overhead, not ICI);
                                    best-of-repeats per point (single-shot was
                                    noise at mesh 4/8 in r3)
-  - threshold_encode_ms_25m        {encode_ms, floor_ms, dense_est_ms}:
-                                   bounded-payload compaction encode+decode
-                                   (slope-timed, HBM-floor-checked; 6.9ms
-                                   where the r3/r4 top_k cost 92.1ms) vs the
-                                   dense reference-semantics encoder
-                                   (bandwidth-bound estimate), both on a
-                                   25M-param flat gradient (DCN codec cost)
+  - threshold_encode_ms_25m        {encode_ms, floor_ms, compaction_ms,
+                                   dense_est_ms}: encode_ms is the product
+                                   encode path on a 25M flat gradient —
+                                   the FUSED Pallas sign-map kernel (one
+                                   pass: compare + sign-pack + residual
+                                   update; ops/pallas_compression.py) vs
+                                   its analytic 9-bytes/elem floor (target
+                                   <=2x; r5's compaction encode ran 3.6x);
+                                   compaction_ms keeps the bounded-payload
+                                   DCN message format measured
+  - collective_overlap             overlapped bucketed gradient sync
+                                   (parallel/overlap.py: small leaves
+                                   densified into ~4MB flat buckets, one
+                                   psum launch each) vs the serialized
+                                   per-leaf post-backward sweep at mesh 4
+                                   and 8 on the virtual-CPU mesh:
+                                   collective_ms each way + the
+                                   overlap_efficiency reduction (target
+                                   >=25% at mesh 8)
 
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
 BENCH_SERVING_S (per-mode closed-loop window, default 6),
@@ -1204,23 +1216,102 @@ def bench_transformer_lm_flax():
 
 
 def bench_threshold_encode():
-    """Encode(+decode) ms on a 25M-element flat gradient (ResNet-50 scale):
-    the bounded-payload COMPACTION encode (round-5: mask -> prefix-sum ->
-    scatter replaced the r3/r4 top_k, whose 25M partial sort cost 92.1ms)
-    AND the dense reference-semantics encoder (elementwise; what
-    EncodedAccumulator uses by default). Slope-timed; the measured time is
-    checked against the HBM floor — a 'measurement' faster than memory
-    bandwidth allows is replaced by the cost-analysis estimate, labeled as
-    such."""
+    """Encode ms on a 25M-element flat gradient (ResNet-50 scale).
+
+    ``encode_ms`` is THE product encode path — EncodedAccumulator's dense
+    sign-map encode through ``threshold_encode_signs``: on TPU the fused
+    Pallas kernel (ONE pass: threshold compare + sign-pack + residual
+    update, ops/pallas_compression.py), elsewhere the XLA elementwise
+    fallback. Its ``floor_ms`` is analytic — 9 bytes/element (4B read +
+    1B signs + 4B residual) over HBM bandwidth; XLA's cost analysis
+    cannot see inside the custom call. Acceptance (ISSUE 5): encode_ms <=
+    2x floor_ms with the kernel enabled (r5's compaction encode ran 3.6x
+    its floor, which made compressed sync lose to dense sync).
+
+    ``compaction_ms`` keeps the bounded-payload format measured (the
+    static-capacity index/sign message for a DCN hop; round-5 replaced
+    the r3/r4 top_k, 92.1ms, with mask -> prefix-sum -> scatter), with
+    its cost-analysis floor. Everything slope-timed with the usual
+    HBM-floor cross-check."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.ops.compression import (threshold_encode_dense,
+                                                    threshold_encode_signs,
                                                     threshold_roundtrip)
+    from deeplearning4j_tpu.ops.pallas_compression import \
+        fused_threshold_encode_applicable
 
     n = 25_000_000
     g = jnp.asarray(np.random.default_rng(0).normal(size=(n,)).astype(np.float32))
+    out = {}
+    zero = jnp.zeros((8, 128), jnp.float32)
 
-    def step(xs, carry):
+    # --- the product path: fused sign-map encode (Pallas on TPU) ---
+    pallas_on = fused_threshold_encode_applicable(n, jnp.float32)
+    out["pallas_kernel"] = bool(pallas_on)
+
+    def signs_step(xs, carry):
+        res, cnt = carry
+        signs, new_res = threshold_encode_signs(res + jnp.sum(xs) * 0, 1e-3)
+        # keep the sign-map output ALIVE across the loop: a full (cheap)
+        # int32 reduce — without it XLA could dead-code the int8 write on
+        # the fallback path and the row would under-measure
+        return new_res, cnt + jnp.sum(jnp.abs(signs.astype(jnp.int32)))
+
+    floor_s = 9.0 * n / (HBM_GBPS * 1e9)
+    out["floor_ms"] = round(floor_s * 1e3, 3)
+    try:
+        try:
+            dt, _ = _slope_measure(signs_step, (zero, (g, jnp.int32(0))),
+                                   n_pair=(16, 64))
+        except BenchImplausible:
+            raise
+        except Exception as e:
+            if not pallas_on:
+                raise
+            # the fused kernel failed to lower/run on this backend: flip
+            # the kill switch and measure the XLA fallback instead of
+            # forfeiting the row (the fallback is the production path
+            # whenever the probe says no)
+            print(f"[bench] fused encode kernel failed ({e!r}); "
+                  f"re-measuring with DL4J_TPU_FUSED_ENCODE=0",
+                  file=sys.stderr)
+            prev_kill = os.environ.get("DL4J_TPU_FUSED_ENCODE")
+            os.environ["DL4J_TPU_FUSED_ENCODE"] = "0"
+            out["pallas_kernel"] = False
+            out["pallas_error"] = repr(e)[:200]
+            try:
+                # fresh jit inside _slope_measure -> the re-measure
+                # re-traces and sees the kill switch
+                dt, _ = _slope_measure(signs_step,
+                                       (zero, (g, jnp.int32(0))),
+                                       n_pair=(16, 64))
+            finally:
+                # scope the flip to this re-measurement: later rows (and
+                # anything else in this process) keep the kernel enabled
+                if prev_kill is None:
+                    os.environ.pop("DL4J_TPU_FUSED_ENCODE", None)
+                else:
+                    os.environ["DL4J_TPU_FUSED_ENCODE"] = prev_kill
+        if dt < floor_s:
+            out["encode_ms"] = None
+            out["encode_est_ms"] = round(floor_s * 1e3, 3)
+            out["encode_note"] = (
+                f"measured {dt*1e3:.3f}ms is below the 9-bytes/elem HBM "
+                f"floor {floor_s*1e3:.3f}ms; bandwidth-bound estimate "
+                "reported instead")
+        else:
+            out["encode_ms"] = round(dt * 1e3, 3)
+            out["vs_floor"] = round(dt / floor_s, 2)
+            out["compaction_r5_ms"] = 6.08   # what the encode cost when the
+            # bench measured the compaction path (r5), and topk before that
+            out["topk_r4_ms"] = 92.1
+    except BenchImplausible as e:
+        out["encode_ms"] = None
+        out["encode_note"] = str(e)
+
+    # --- the bounded-payload compaction format (DCN message) ---
+    def compaction_step(xs, carry):
         (res,) = carry
         # update is still computed inside the jitted roundtrip (it is a
         # returned output); only new_res feeds the next iteration
@@ -1228,34 +1319,24 @@ def bench_threshold_encode():
             res + jnp.sum(xs) * 0, threshold=1e-3, capacity=n // 100)
         return (new_res,)
 
-    out = {}
-    zero = jnp.zeros((8, 128), jnp.float32)
-    try:
-        dt, _ = _slope_measure(step, (zero, (g,)), n_pair=(16, 64))
-    except BenchImplausible as e:
-        out["encode_ms"] = None
-        out["encode_note"] = str(e)
-        dt = None
-
-    # HBM floor for the roundtrip (mask + prefix-sum + scatter + decode:
-    # a handful of passes over the 100MB buffer)
     try:
         compiled = jax.jit(lambda r: threshold_roundtrip(
             r, threshold=1e-3, capacity=n // 100)[1]).lower(g).compile()
-        floor_s = float(_cost_analysis(compiled).get("bytes accessed", 2e8)) \
+        cfloor_s = float(_cost_analysis(compiled).get("bytes accessed", 2e8)) \
             / (HBM_GBPS * 1e9)
     except Exception:
-        floor_s = 2e8 / (HBM_GBPS * 1e9)
-    out["floor_ms"] = round(floor_s * 1e3, 3)
-    if dt is not None and dt < floor_s:
-        out["encode_ms"] = None
-        out["encode_est_ms"] = round(floor_s * 1e3, 3)
-        out["encode_note"] = (f"measured {dt*1e3:.3f}ms is below the HBM "
-                              f"floor {floor_s*1e3:.3f}ms; bandwidth-bound "
-                              "estimate reported instead")
-    elif dt is not None:
-        out["encode_ms"] = round(dt * 1e3, 3)
-        out["topk_r4_ms"] = 92.1    # what this row cost before compaction
+        cfloor_s = 2e8 / (HBM_GBPS * 1e9)
+    out["compaction_floor_ms"] = round(cfloor_s * 1e3, 3)
+    try:
+        dt, _ = _slope_measure(compaction_step, (zero, (g,)), n_pair=(16, 64))
+        if dt < cfloor_s:
+            out["compaction_ms"] = None
+            out["compaction_est_ms"] = round(cfloor_s * 1e3, 3)
+        else:
+            out["compaction_ms"] = round(dt * 1e3, 3)
+    except BenchImplausible as e:
+        out["compaction_ms"] = None
+        out["compaction_note"] = str(e)
 
     # The dense encoder is a single fused elementwise pass; its ~0.25ms is
     # far below every transport artifact on this rig (slope AND chained
@@ -1275,6 +1356,141 @@ def bench_threshold_encode():
         print(f"dense cost-analysis estimate unavailable: {e}",
               file=sys.stderr)
     return out
+
+
+def bench_collective_overlap(meshes=(4, 8), total_elems=500_000,
+                             bucket_bytes=512 * 1024, timeout=420):
+    """Overlapped bucketed gradient sync (parallel/overlap.bucketed_pmean:
+    small leaves densified into flat buckets, one psum launch each) vs
+    the SERIALIZED post-backward sweep (one pmean bind per leaf — what
+    the pre-overlap sync path dispatched) on a ResNet-50-shaped leaf
+    distribution (~165 leaves: a few big conv kernels, many small BN/bias
+    vectors), at mesh 4 and 8 on the virtual-CPU mesh.
+
+    The row isolates LAUNCH overhead — the O(leaves) per-collective cost
+    that serializes after the backward and that bucketing eliminates —
+    so the tree is scaled to ~2MB total: at that size the collectives'
+    byte cost (identical between the two schemes by construction, and
+    already tracked by ``collective_overhead_by_mesh``) stays under the
+    launch cost instead of drowning it. Every variant ends in the same
+    per-leaf elementwise consumer, mirroring the real step (the unpack
+    slices fuse into the updater math there, so they must be fusable
+    here too). collective_ms = synced - nosync per variant (clamped at
+    0: overlapped sync at this scale can measure BELOW the bare per-leaf
+    op floor), interleaved medians; ``sync_step_reduction`` is the
+    direct serialized-vs-overlapped wall ratio, immune to the baseline
+    subtraction. True comm/compute interleaving additionally needs real
+    ICI, which this rig does not have. Runs in a subprocess so the CPU
+    platform doesn't poison this process."""
+    code = r"""
+import json, time, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_map
+from deeplearning4j_tpu.parallel.overlap import (build_bucket_schedule,
+                                                 bucketed_pmean)
+
+MESHES = %(meshes)r
+TOTAL = %(total)d
+BUCKET = %(bucket)d
+
+# ResNet-50-shaped leaf distribution, scaled to TOTAL elements: a few
+# large conv kernels carry most of the mass, ~2/3 of the leaves are tiny
+# BN scale/shift/stats vectors (the launch-overhead victims)
+base = []
+for f_in, f_out, k, n in [(64, 64, 1, 6), (64, 64, 3, 6), (256, 128, 1, 8),
+                          (128, 128, 3, 8), (512, 256, 1, 12),
+                          (256, 256, 3, 12), (1024, 512, 1, 6),
+                          (512, 512, 3, 6)]:
+    base += [f_in * f_out * k * k] * n
+base += [2048 * 1000]
+base += [s for v in (64, 256, 512, 1024, 2048) for s in [v] * 20]
+scale = TOTAL / float(sum(base))
+sizes = [max(8, int(s * scale)) for s in base]
+rng = np.random.default_rng(0)
+leaves = tuple(jnp.asarray(rng.normal(size=(s,)).astype(np.float32))
+               for s in sizes)
+schedule = build_bucket_schedule(leaves, BUCKET)
+
+# the shared per-leaf consumer (the 'updater'): the overlap path's
+# unpack slices must be fusable into it, as they are in the real step
+def consume(ls):
+    return tuple(l * 0.5 for l in ls)
+
+def serialized(*ls):      # the pre-overlap sweep: one pmean bind per leaf
+    return consume(tuple(jax.lax.pmean(l, "data") for l in ls))
+
+def overlapped(*ls):
+    return consume(bucketed_pmean(tuple(ls), schedule, "data"))
+
+def nosync(*ls):
+    return consume(ls)
+
+out = {"leaves": len(sizes), "buckets": len(schedule),
+       "total_mb": round(sum(sizes) * 4 / 1e6, 2)}
+VARIANTS = (("serialized", serialized), ("overlapped", overlapped),
+            ("nosync", nosync))
+for ndev in MESHES:
+    mesh = make_mesh((ndev,), ("data",), devices=jax.devices()[:ndev])
+    compiled = {}
+    for name, fn in VARIANTS:
+        j = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P(),) * len(leaves),
+                              out_specs=(P(),) * len(leaves),
+                              check_vma=False))
+        compiled[name] = j.lower(*leaves).compile()
+        jax.block_until_ready(compiled[name](*leaves))   # warm
+    # multi-replica CPU timings on a shared box swing tens of percent
+    # between back-to-back identical runs: INTERLEAVE the variants so
+    # drift hits all three equally, and take per-variant MEDIANS over
+    # enough windows for a stable central estimate (same protocol as the
+    # telemetry_overhead row)
+    times = {name: [] for name, _ in VARIANTS}
+    for _ in range(11):
+        for name, _ in VARIANTS:
+            c = compiled[name]
+            t0 = time.perf_counter()
+            for _ in range(3):
+                r = c(*leaves)
+            jax.block_until_ready(r)
+            times[name].append((time.perf_counter() - t0) / 3)
+    row = {name + "_ms": round(float(np.median(ts)) * 1e3, 3)
+           for name, ts in times.items()}
+    cs = max(row["serialized_ms"] - row["nosync_ms"], 0.0)
+    co = max(row["overlapped_ms"] - row["nosync_ms"], 0.0)
+    row["collective_ms_serialized"] = round(cs, 3)
+    row["collective_ms_overlapped"] = round(co, 3)
+    row["overlap_efficiency"] = round(min(1.0 - co / cs, 1.0), 4) \
+        if cs > 0 else None
+    row["sync_step_reduction"] = round(
+        1.0 - row["overlapped_ms"] / row["serialized_ms"], 4) \
+        if row["serialized_ms"] > 0 else None
+    out[str(ndev)] = row
+out["note"] = ("virtual CPU devices: serialized = one pmean bind per leaf "
+               "(the pre-overlap post-backward sweep), overlapped = "
+               "flat-bucketed psums (%%dKB buckets), both feeding the "
+               "same fused per-leaf consumer; collective_ms = synced - "
+               "nosync (clamped at 0), interleaved medians of 11x3 "
+               "calls; launch-count reduction is what's measurable "
+               "without real ICI" %% (BUCKET // 1024))
+print(json.dumps(out))
+""" % {"meshes": tuple(meshes), "total": int(total_elems),
+       "bucket": int(bucket_bytes)}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=timeout, env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(f"collective-overlap subprocess failed "
+                           f"(rc={out.returncode}): "
+                           f"{out.stderr.strip()[-500:]}")
+    return json.loads(lines[-1])
 
 
 def bench_collective_overhead():
@@ -1560,6 +1776,7 @@ def main():
             ("telemetry_overhead", bench_telemetry_overhead),
             ("serving_throughput", bench_serving),
             ("threshold_encode_ms_25m", bench_threshold_encode),
+            ("collective_overlap", bench_collective_overlap),
             ("collective_overhead_by_mesh", bench_collective_overhead),
             ("resnet50_amp_img_per_sec", _amp_ours),
             ("resnet50_piped_img_per_sec", _piped),
@@ -1583,7 +1800,9 @@ def main():
         # the per-row emission above still bounds the loss to the stuck
         # row and later rows, which only the driver's kill can reclaim.
         # The collective row manages its own 420s subprocess timeout.
-        cap = 460.0 if name == "collective_overhead_by_mesh" else \
+        # the collective rows manage their own subprocess timeouts
+        cap = 460.0 if name in ("collective_overhead_by_mesh",
+                                "collective_overlap") else \
             min(row_cap, budget - elapsed + 60.0)
         signal.setitimer(signal.ITIMER_REAL, cap)
         try:

@@ -90,6 +90,28 @@ def threshold_decode(payload: ThresholdPayload, threshold: float, size: int,
         mode="drop")
 
 
+def threshold_encode_signs(residual: jnp.ndarray, threshold: float
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense-semantics encode emitting the int8 SIGN MAP wire format:
+    ``(signs, new_residual)`` with ``signs`` in {-1, 0, +1} (the update is
+    ``signs * threshold``) and the Strom residual carrying the unsent
+    mass. Routed through the fused Pallas kernel when applicable — one
+    pass: threshold compare + sign-pack + residual update, no
+    intermediate f32 ``sent`` in HBM (ops/pallas_compression.py) — else
+    the XLA elementwise path below (bit-identical; tests pin it). This is
+    what ``EncodedAccumulator``'s dense path calls."""
+    from .pallas_compression import (fused_threshold_encode_applicable,
+                                     threshold_encode_pallas)
+    if residual.ndim == 1 and \
+            fused_threshold_encode_applicable(residual.shape[0],
+                                              residual.dtype):
+        return threshold_encode_pallas(residual, threshold)
+    t = jnp.asarray(threshold, residual.dtype)
+    s = jnp.where(jnp.abs(residual) >= t, jnp.sign(residual),
+                  jnp.zeros((), residual.dtype))
+    return s.astype(jnp.int8), residual - s * t
+
+
 def threshold_encode_dense(residual: jnp.ndarray, threshold: float
                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """EXACT reference semantics (EncodingHandler.java:64-66): quantize

@@ -1,6 +1,10 @@
-from .mesh import data_sharding, make_mesh, replicated
+from .mesh import data_sharding, make_mesh, replicated, window_sharding
 from .data_parallel import ParallelWrapper
 from .inference import ParallelInference
+from .overlap import (BucketSchedule, GradBucket, build_bucket_schedule,
+                      bucketed_pmean, fused_pmean, profile_schedule)
 
-__all__ = ["data_sharding", "make_mesh", "replicated", "ParallelWrapper",
-           "ParallelInference"]
+__all__ = ["data_sharding", "make_mesh", "replicated", "window_sharding",
+           "ParallelWrapper", "ParallelInference",
+           "BucketSchedule", "GradBucket", "build_bucket_schedule",
+           "bucketed_pmean", "fused_pmean", "profile_schedule"]

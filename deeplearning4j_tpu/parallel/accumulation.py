@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.compression import (threshold_decode, threshold_encode,
-                               threshold_encode_dense)
+                               threshold_encode_signs)
 
 
 class GradientsAccumulator:
@@ -98,8 +98,14 @@ class EncodedAccumulator(GradientsAccumulator):
     def combine(self, flat_grad, state, axis="data"):
         residual = state + flat_grad
         if self.encoder == "dense":
-            sent, new_residual = threshold_encode_dense(residual,
-                                                        self.threshold)
+            # sign-map front door: ONE fused pass (Pallas kernel when
+            # applicable, XLA elementwise fallback — bit-identical); the
+            # f32 update peers apply is reconstructed from the int8 map
+            # only as the psum operand
+            signs, new_residual = threshold_encode_signs(residual,
+                                                         self.threshold)
+            sent = signs.astype(residual.dtype) * \
+                jnp.asarray(self.threshold, residual.dtype)
             return jax.lax.pmean(sent, axis), new_residual
         capacity = max(1, int(self.capacity_fraction * flat_grad.shape[0]))
         payload, new_residual = threshold_encode(residual, self.threshold,

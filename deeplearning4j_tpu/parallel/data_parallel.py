@@ -38,7 +38,10 @@ from ..datasets.prefetch import BatchWindow, DevicePrefetchIterator, iter_window
 from ..optimize.listeners import PerformanceListener, TrainingListener
 from ..optimize.solver import cast_feed, train_step_math
 from ..telemetry import get_registry, span
-from .mesh import data_sharding, make_mesh, replicated, shard_map
+from .mesh import (data_sharding, make_mesh, replicated, shard_map,
+                   window_sharding)
+from .overlap import (DEFAULT_BUCKET_BYTES, build_bucket_schedule,
+                      bucketed_pmean, fused_pmean)
 
 
 class ParallelWrapper:
@@ -64,13 +67,33 @@ class ParallelWrapper:
     window. Ragged remainder windows fall back per-step; the averaging
     path (averaging_frequency>1) is already a fused K-step program and
     ignores this knob.
+
+    ``overlap_sync=True`` (sync path, no accumulator): bucketed
+    backward-overlap gradient synchronization (parallel/overlap.py) —
+    the grad tree is all-reduced per ~``bucket_bytes`` bucket (small
+    leaves densified into one flat psum each, packed in reverse leaf
+    order) instead of the monolithic per-leaf post-backward sweep, so
+    collectives launch as their gradients are produced and the sync
+    dispatches O(buckets) collectives instead of O(leaves). Composes
+    with ``steps_per_dispatch`` (the scan body carries the same
+    schedule). Bit-identical to the unbucketed path at every bucket
+    size (tests/test_overlap_sync.py).
+
+    On every sync path (plain and overlap), a batch whose size does not
+    tile the mesh — the end-of-epoch remainder the prefetcher ships
+    unsharded — dispatches through a replicated-feed program for that
+    step instead of raising the divisibility error; the update is
+    identical. The explicit-accumulator path keeps the loud error (its
+    per-worker carry has no replicated equivalent).
     """
 
     def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, training_mode: str = "shared_gradients",
                  average_updaters: bool = True, prefetch_buffer: int = 2,
                  report_score_after_averaging: bool = True,
-                 gradient_accumulator=None, steps_per_dispatch: int = 1):
+                 gradient_accumulator=None, steps_per_dispatch: int = 1,
+                 overlap_sync: bool = False,
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES):
         self.net = net
         devices = jax.devices()
         if workers is not None and mesh is None:
@@ -103,15 +126,43 @@ class ParallelWrapper:
             raise ValueError(
                 "steps_per_dispatch applies to the plain sync all-reduce "
                 "path; the GradientsAccumulator path dispatches per step")
+        # Bucketed backward-overlap gradient sync (parallel/overlap.py):
+        # shard_map step with per-bucket flat psums instead of the GSPMD
+        # monolithic post-backward sweep. Orthogonal to the accumulator
+        # seam (which owns its own combine) — refuse the combination.
+        if overlap_sync and gradient_accumulator is not None:
+            raise ValueError(
+                "overlap_sync schedules the plain psum exchange in buckets; "
+                "a GradientsAccumulator owns its own combine — pick one")
+        if overlap_sync and self.training_mode == "averaging" \
+                and self.averaging_frequency > 1:
+            raise ValueError(
+                "overlap_sync applies to the per-step sync all-reduce path; "
+                "the K-step averaging path already runs ONE fused variadic "
+                "pmean launch per window — it would silently ignore the "
+                "bucket schedule")
+        self.overlap_sync = overlap_sync
+        self.bucket_bytes = bucket_bytes
+        self._bucket_schedule = None     # built lazily from net.params
         self.steps_per_dispatch = steps_per_dispatch
         self._acc_state = None
         self._sync_step = None
         self._sync_window_step = None
+        # Replicated-feed programs for sync batches that don't tile the
+        # mesh (shard_map AND jit+in_shardings both enforce batch-dim
+        # divisibility): the end-of-epoch remainder the prefetcher ships
+        # unsharded dispatches through these instead of killing the
+        # epoch. Built lazily; the update is identical (the psum over a
+        # sharded batch == the replicated full-batch computation).
+        self._remainder_step = None
+        self._remainder_window_step = None
         self._avg_steps = {}   # keyed by chunk count (remainder batches differ)
 
     # ------------------------------------------------------------- sync path
-    def _build_sync_step(self):
-        """Per-step all-reduce DP: jit over the mesh, batch sharded."""
+    def _build_sync_step(self, feed_sharding=None):
+        """Per-step all-reduce DP: jit over the mesh, batch sharded.
+        ``feed_sharding`` overrides the x/y sharding (the remainder
+        program passes replicated)."""
         net = self.net
         mesh = self.mesh
 
@@ -120,13 +171,14 @@ class ParallelWrapper:
                                    x, y)
 
         rep = replicated(mesh)
-        dsh = data_sharding(mesh)
+        dsh = feed_sharding if feed_sharding is not None \
+            else data_sharding(mesh)
         return jax.jit(
             step, donate_argnums=(0, 2),
             in_shardings=(rep, rep, rep, rep, rep, dsh, dsh),
             out_shardings=(rep, rep, rep, rep))
 
-    def _build_sync_window_step(self):
+    def _build_sync_window_step(self, feed_sharding=None):
         """K fused sync-DP steps in ONE jitted lax.scan program: xs/ys are
         [K, batch, ...] with the batch dim sharded on the data axis (each
         scan iteration consumes one data-sharded batch; GSPMD inserts the
@@ -150,11 +202,108 @@ class ParallelWrapper:
             return params, state, opt_state, losses
 
         rep = replicated(mesh)
-        wsh = NamedSharding(mesh, P(None, "data"))   # [K, batch, ...]
+        wsh = feed_sharding if feed_sharding is not None \
+            else window_sharding(mesh)   # [K, batch, ...]
         return jax.jit(
             window_step, donate_argnums=(0, 2),
             in_shardings=(rep, rep, rep, rep, rep, wsh, wsh),
             out_shardings=(rep, rep, rep, rep))
+
+    # -------------------------------------------------- overlapped sync path
+    def _grad_schedule(self):
+        """Bucket schedule over the param/grad tree (built once; the grad
+        tree from value_and_grad shares the params' treedef)."""
+        if self._bucket_schedule is None:
+            self._bucket_schedule = build_bucket_schedule(
+                self.net.params, self.bucket_bytes)
+            reg = get_registry()
+            if reg.enabled:
+                reg.gauge("parallel.bucket_count").set(
+                    len(self._bucket_schedule))
+        return self._bucket_schedule
+
+    def _build_overlap_step(self):
+        """Bucketed backward-overlap sync DP (parallel/overlap.py): each
+        worker differentiates its local shard under shard_map, then the
+        grad tree is all-reduced per ~bucket_bytes bucket — small leaves
+        densified into one flat buffer per bucket (one psum launch each,
+        arXiv:1905.04035), buckets packed in reverse leaf order so the
+        collectives' data dependences let XLA's latency-hiding scheduler
+        start ICI traffic while the backward is still producing earlier
+        layers' gradients (arXiv:2004.13336) — vs the GSPMD path's
+        monolithic O(leaves) post-backward sweep. State and loss ride ONE
+        fused variadic pmean after the updater."""
+        net = self.net
+        mesh = self.mesh
+        schedule = self._grad_schedule()
+
+        def worker_step(params, state, opt_state, it, rng, x, y):
+            new_params, new_state, new_opt, loss = train_step_math(
+                net, params, state, opt_state, it, rng, x, y,
+                grad_sync=lambda g: bucketed_pmean(g, schedule, "data"))
+            new_state, loss = fused_pmean((new_state, loss), "data")
+            return new_params, new_state, new_opt, loss
+
+        rep, dsh = P(), P("data")
+        fn = shard_map(worker_step, mesh=mesh,
+                       in_specs=(rep, rep, rep, rep, rep, dsh, dsh),
+                       out_specs=(rep, rep, rep, rep), check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2))
+
+    def _build_overlap_window_step(self):
+        """K fused steps of the bucketed-overlap sync path in ONE lax.scan
+        program: the scan body is ``train_step_math`` with the SAME bucket
+        schedule as ``_build_overlap_step`` (the grad_sync seam carries it
+        into the fused window structurally), so K fused steps stay
+        bit-identical to K per-step overlap dispatches."""
+        net = self.net
+        mesh = self.mesh
+        schedule = self._grad_schedule()
+
+        def window_step(params, state, opt_state, it0, base_rng, xs, ys):
+            def body(carry, inp):
+                params, state, opt_state, it = carry
+                x, y = inp
+                rng = jax.random.fold_in(base_rng, it)
+                new_params, new_state, new_opt, loss = train_step_math(
+                    net, params, state, opt_state, it, rng, x, y,
+                    grad_sync=lambda g: bucketed_pmean(g, schedule, "data"))
+                new_state, loss = fused_pmean((new_state, loss), "data")
+                return (new_params, new_state, new_opt, it + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, it0), (xs, ys))
+            return params, state, opt_state, losses
+
+        rep, wsh = P(), P(None, "data")
+        fn = shard_map(window_step, mesh=mesh,
+                       in_specs=(rep, rep, rep, rep, rep, wsh, wsh),
+                       out_specs=(rep, rep, rep, rep), check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2))
+
+    def _remainder_step_fn(self):
+        """The sync step with x/y REPLICATED: serves batches whose size
+        does not tile the mesh — shard_map (overlap path) and
+        jit+in_shardings (GSPMD path) both enforce batch-dim
+        divisibility, so a 36-sample remainder on an 8-device mesh would
+        otherwise kill the epoch. Every device redundantly computes the
+        full remainder batch; the update is identical to what a sharded
+        dispatch would produce (GSPMD's psum over per-shard partials IS
+        the full-batch reduction), matching the contract of the
+        prefetcher shipping remainders unsharded and iter_windows
+        dropping ragged groups to per-step."""
+        if self._remainder_step is None:
+            self._remainder_step = self._build_sync_step(
+                feed_sharding=replicated(self.mesh))
+        return self._remainder_step
+
+    def _remainder_window_step_fn(self):
+        """Window variant of ``_remainder_step_fn`` (uniformly
+        non-divisible batch sizes stack into regular windows too)."""
+        if self._remainder_window_step is None:
+            self._remainder_window_step = self._build_sync_window_step(
+                feed_sharding=replicated(self.mesh))
+        return self._remainder_window_step
 
     # ------------------------------------------------------ accumulator path
     def _build_accum_step(self):
@@ -178,9 +327,10 @@ class ParallelWrapper:
             # math (and its replicated state) stays in lockstep
             new_params, new_opt = net.updater.update(unravel(combined),
                                                      opt_state, params, it)
-            new_state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), new_state)
-            return (new_params, new_state, new_opt, new_acc[None],
-                    jax.lax.pmean(loss, "data"))
+            # state + loss in one variadic pmean bind (vs a per-leaf tree
+            # sweep plus a separate scalar launch)
+            new_state, loss = fused_pmean((new_state, loss), "data")
+            return new_params, new_state, new_opt, new_acc[None], loss
 
         rep, dsh = P(), P("data")
         fn = shard_map(worker_step, mesh=mesh,
@@ -222,12 +372,18 @@ class ParallelWrapper:
 
             (params, state, opt_state, _), losses = jax.lax.scan(
                 body, (params, state, opt_state, 0), (xs, ys))
-            # parameter averaging across workers (reference :332-361)
-            params = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), params)
-            state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), state)
+            # parameter averaging across workers (reference :332-361):
+            # params, state, (opt_state) and the scalar loss all ride ONE
+            # variadic pmean bind instead of three per-leaf tree sweeps
+            # plus a scalar launch — same elementwise math, O(1) dispatch
+            mean_loss = jnp.mean(losses)
             if avg_upd:
-                opt_state = jax.tree.map(lambda a: jax.lax.pmean(a, "data"), opt_state)
-            return params, state, opt_state, jax.lax.pmean(jnp.mean(losses), "data")
+                params, state, opt_state, mean_loss = fused_pmean(
+                    (params, state, opt_state, mean_loss), "data")
+            else:
+                params, state, mean_loss = fused_pmean(
+                    (params, state, mean_loss), "data")
+            return params, state, opt_state, mean_loss
 
         rep_spec = P()
         dsh_spec = P(None, "data")  # [K, batch, ...] -> shard batch dim
@@ -245,9 +401,12 @@ class ParallelWrapper:
             net.init()
         sync = self.training_mode == "shared_gradients" or self.averaging_frequency == 1
         if sync and self._sync_step is None:
-            self._sync_step = (self._build_accum_step()
-                               if self.gradient_accumulator is not None
-                               else self._build_sync_step())
+            if self.gradient_accumulator is not None:
+                self._sync_step = self._build_accum_step()
+            elif self.overlap_sync:
+                self._sync_step = self._build_overlap_step()
+            else:
+                self._sync_step = self._build_sync_step()
         dtype = jnp.dtype(net.conf.dtype)
         base_rng = jax.random.PRNGKey(net.conf.seed + 31337)
         perf = [l for l in net.listeners if isinstance(l, PerformanceListener)]
@@ -310,6 +469,11 @@ class ParallelWrapper:
             # per epoch, one locked int add per iteration
             _c_iters = reg.counter("train.iterations")
             _c_windows = reg.counter("train.windows")
+            # host-side collective accounting on the overlap path: grad
+            # buckets + the fused state/loss launch, per executed step
+            _c_coll = reg.counter("parallel.collective_launches")
+            _n_buckets = len(self._grad_schedule()) if self.overlap_sync else 0
+            _n_coll = (_n_buckets + 1) if self.overlap_sync else 0
             windowed = (self.steps_per_dispatch > 1
                         and self.gradient_accumulator is None)
             stream = (iter_windows(it_wrapped, self.steps_per_dispatch)
@@ -322,14 +486,24 @@ class ParallelWrapper:
                     etl_ms = (time.perf_counter() - _t0) * 1e3
                 if isinstance(item, BatchWindow):
                     if self._sync_window_step is None:
-                        self._sync_window_step = \
-                            self._build_sync_window_step()
+                        self._sync_window_step = (
+                            self._build_overlap_window_step()
+                            if self.overlap_sync
+                            else self._build_sync_window_step())
                     k = len(item)
                     with span("window", k=k, iteration=net.iteration_count):
                         xs, ys, _, _ = item.stacked(cast=feed)
-                        with span("dispatch", k=k):
+                        wstep = self._sync_window_step
+                        n_coll = _n_coll
+                        if xs.shape[1] % self.n != 0:
+                            # batch size doesn't tile the mesh: dispatch
+                            # the replicated window program (identical
+                            # update) instead of the divisibility error
+                            wstep = self._remainder_window_step_fn()
+                            n_coll = 0
+                        with span("dispatch", k=k, buckets=_n_buckets):
                             (net.params, net.state, net.opt_state,
-                             losses) = self._sync_window_step(
+                             losses) = wstep(
                                 net.params, net.state, net.opt_state,
                                 jnp.asarray(net.iteration_count, jnp.int32),
                                 base_rng, xs, ys)
@@ -337,6 +511,8 @@ class ParallelWrapper:
                             (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
                         _c_windows.inc()
                         _c_iters.inc(k)
+                        if n_coll:
+                            _c_coll.inc(k * n_coll)
                         for p in perf:
                             p.note_window(k)
                         for i, d in enumerate(item.datasets):
@@ -354,6 +530,7 @@ class ParallelWrapper:
                     y = feed(ds.labels)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
                     it = jnp.asarray(net.iteration_count, jnp.int32)
+                    n_coll = _n_coll
                     if self.gradient_accumulator is not None:
                         if self._acc_state is None:
                             self._acc_state = self._init_acc_state(dtype)
@@ -362,12 +539,19 @@ class ParallelWrapper:
                             net.params, net.state, net.opt_state,
                             self._acc_state, it, rng, x, y)
                     else:
+                        step = self._sync_step
+                        if x.shape[0] % self.n != 0:
+                            # remainder batch: replicated fallback
+                            step = self._remainder_step_fn()
+                            n_coll = 0
                         net.params, net.state, net.opt_state, loss = \
-                            self._sync_step(net.params, net.state,
-                                            net.opt_state, it, rng, x, y)
+                            step(net.params, net.state,
+                                 net.opt_state, it, rng, x, y)
                     device_ms = max(
                         (time.perf_counter() - _t0) * 1e3 - etl_ms, 0.0)
                     _c_iters.inc()
+                    if n_coll:
+                        _c_coll.inc(n_coll)
                     self._notify(perf, ds, loss, etl_wait_ms=etl_ms,
                                  device_ms=device_ms)
                     net.iteration_count += 1

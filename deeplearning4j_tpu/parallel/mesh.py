@@ -50,5 +50,11 @@ def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def window_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """[K, batch, ...] feed sharding for fused K-step windows: the scan
+    axis replicated, the batch dim split across ``axis``."""
+    return NamedSharding(mesh, P(None, axis))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

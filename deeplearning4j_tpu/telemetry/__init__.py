@@ -25,12 +25,14 @@ from .jaxsignals import (HostSyncDetector, HostSyncError, RecompileDetector,
                          xla_compile_count)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, set_registry)
-from .spans import Span, current_span, current_span_path, span
+from .spans import (Span, current_span, current_span_path,
+                    record_external_span, span)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "get_registry", "set_registry",
     "Span", "span", "current_span", "current_span_path",
+    "record_external_span",
     "RecompileDetector", "HostSyncDetector", "HostSyncError",
     "device_memory_gauges", "xla_compile_count", "ensure_monitoring_hook",
     "reset",

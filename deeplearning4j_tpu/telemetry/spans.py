@@ -23,7 +23,8 @@ from typing import List, Optional
 
 from .registry import MetricsRegistry, get_registry
 
-__all__ = ["Span", "span", "current_span", "current_span_path"]
+__all__ = ["Span", "span", "current_span", "current_span_path",
+           "record_external_span"]
 
 # Chrome-trace timestamps are microseconds; anchor perf_counter_ns to the
 # unix epoch once so every event in a process shares one clock domain.
@@ -143,6 +144,31 @@ def span(name: str, **attrs):
         jaxsignals.ensure_monitoring_hook()   # compiles attribute to spans
         _hook_ready = True
     return Span(name, reg, attrs)
+
+
+def record_external_span(name: str, dur_ms: float, cat: str = "external",
+                         **attrs) -> None:
+    """Land a Chrome-trace complete event for a duration measured OUTSIDE
+    the span stack (a profiled collective, a subprocess stage, an
+    externally-timed region), attributed under the innermost open span's
+    path like the jaxsignals compile events. ``cat`` distinguishes it from
+    lexical spans — tools/trace2summary.py folds non-span categories into
+    their own ``[name]`` buckets (per-bucket for cat="collective" events
+    carrying a ``bucket`` attr) instead of inflating the enclosing span."""
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    # args.path carries the ENCLOSING span path (same contract as the
+    # backend_compile events): trace2summary appends "[name]" itself
+    args = dict(attrs)
+    args["path"] = current_span_path()
+    now_ns = time.perf_counter_ns()
+    dur_us = max(0, int(dur_ms * 1000))
+    reg.record_event({"name": name, "ph": "X", "cat": cat,
+                      "ts": (now_ns + _EPOCH_NS) // 1000 - dur_us,
+                      "dur": dur_us, "pid": 1,
+                      "tid": threading.get_ident() & 0xFFFFFFFF,
+                      "args": args})
 
 
 def current_span() -> Optional[Span]:

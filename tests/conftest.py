@@ -16,6 +16,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # on CPU; production CPU runs take the (much faster) XLA fallbacks instead.
 os.environ.setdefault("DL4J_TPU_FUSED_LSTM_INTERPRET", "1")
 os.environ.setdefault("DL4J_TPU_FUSED_ATTN_INTERPRET", "1")
+os.environ.setdefault("DL4J_TPU_FUSED_ENCODE_INTERPRET", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -160,6 +160,88 @@ def test_dense_encode_exact_reference_semantics():
                                np.asarray(r) - np.asarray(sent), atol=1e-9)
 
 
+def test_encoded_accumulator_bf16_gradients():
+    """bf16 gradients through the dense EncodedAccumulator on the 8-device
+    mesh: the combine stays in bf16 end to end (no silent f32 promotion)
+    and matches the manual bf16 threshold math exactly."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel.accumulation import EncodedAccumulator
+    from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_map
+
+    n, sz = 8, 64
+    mesh = make_mesh((n,), ("data",))
+    acc = EncodedAccumulator(threshold=1e-2)
+    grads = jnp.asarray(R.normal(0, 2e-2, (n, sz)), jnp.bfloat16)
+    state = acc.init(sz, jnp.bfloat16)
+    assert state.dtype == jnp.bfloat16
+    states = jnp.broadcast_to(state, (n, sz))
+
+    def worker(g, s):
+        u, ns = acc.combine(g[0], s[0], axis="data")
+        return u[None], ns[None]
+
+    u, ns = jax.jit(shard_map(worker, mesh=mesh,
+                              in_specs=(P("data"), P("data")),
+                              out_specs=(P("data"), P("data")),
+                              check_vma=False))(grads, states)
+    assert u.dtype == jnp.bfloat16 and ns.dtype == jnp.bfloat16
+    t = jnp.asarray(1e-2, jnp.bfloat16)
+    sent = jnp.where(jnp.abs(grads) >= t, jnp.sign(grads) * t,
+                     jnp.zeros((), jnp.bfloat16))
+    np.testing.assert_array_equal(
+        np.asarray(ns, np.float32), np.asarray(grads - sent, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(u[0], np.float32),
+        np.asarray(jnp.mean(sent.astype(jnp.float32), axis=0)), atol=1e-2)
+
+
+def test_all_below_threshold_step_ships_nothing():
+    """A step where NO entry clears the threshold: the dense path ships an
+    all-zero update and the residual is carried bit-exactly; the topk
+    payload is EMPTY (count 0, all slots sign 0) and decodes to zero."""
+    g = jnp.asarray(R.normal(0, 1e-4, (256,)).astype(np.float32))
+    # dense
+    from deeplearning4j_tpu.ops.compression import threshold_encode_signs
+    signs, res = threshold_encode_signs(g, 1.0)
+    assert int(jnp.sum(jnp.abs(signs.astype(jnp.int32)))) == 0
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(g))
+    # bounded payload
+    payload, res2 = threshold_encode(g, 1.0, capacity=32)
+    assert int(payload.count) == 0
+    assert int(jnp.sum(jnp.abs(payload.signs.astype(jnp.int32)))) == 0
+    np.testing.assert_array_equal(np.asarray(res2), np.asarray(g))
+    update = threshold_decode(payload, 1.0, 256, g.dtype)
+    assert not np.any(np.asarray(update))
+
+
+def test_residual_carry_bit_exact_across_steps():
+    """>=3 consecutive combine steps: the residual state must equal the
+    sequentially-computed reference BITWISE at every step (error feedback
+    drifts when the carry is even one ulp off)."""
+    from deeplearning4j_tpu.ops.compression import threshold_encode_signs
+
+    size = 512
+    threshold = 5e-3
+    rng = np.random.default_rng(77)
+    grads = [jnp.asarray(rng.normal(0, 4e-3, (size,)).astype(np.float32))
+             for _ in range(4)]
+    res = jnp.zeros((size,), jnp.float32)
+    ref = np.zeros((size,), np.float32)
+    t32 = np.float32(threshold)
+    for g in grads:
+        signs, res = threshold_encode_signs(res + g, threshold)
+        # numpy reference computed in f32 with identical op order
+        acc = ref + np.asarray(g)
+        s = np.where(np.abs(acc) >= t32, np.sign(acc).astype(np.float32),
+                     np.float32(0))
+        ref = acc - s * t32
+        np.testing.assert_array_equal(np.asarray(res), ref)
+        np.testing.assert_array_equal(
+            np.asarray(signs), s.astype(np.int8))
+
+
 def test_encoded_accumulator_dense_matches_manual():
     """EncodedAccumulator(encoder='dense') on the 8-device mesh: the applied
     update equals the mean of per-worker thresholded residuals, and the

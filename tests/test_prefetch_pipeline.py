@@ -386,3 +386,113 @@ def test_full_overlapped_pipeline_end_to_end(tmp_path, rng):
         features=x, labels=y, batch_size=16), epochs=2, async_prefetch=False)
     np.testing.assert_array_equal(np.asarray(a.params_flat()),
                                   np.asarray(b.params_flat()))
+
+
+# ----------------------------------------- staging pool + bandwidth gauge
+def test_host_to_device_gbps_gauge_published(rng):
+    """The producer's periodic blocking transfer sample must land on the
+    iterator attribute AND the prefetch.host_to_device_gbps gauge."""
+    from deeplearning4j_tpu import telemetry
+    telemetry.reset()
+    x = rng.normal(size=(64, 4)).astype(np.float64)
+    y = np.eye(3, dtype=np.float64)[rng.integers(0, 3, 64)]
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(features=x, labels=y, batch_size=16),
+        depth=2, dtype="float32")
+    list(it)
+    assert it.host_to_device_gbps > 0
+    gauge = telemetry.get_registry().gauge("prefetch.host_to_device_gbps")
+    assert gauge.value == pytest.approx(it.host_to_device_gbps)
+
+
+def test_cast_batches_correct_with_staging_pool(rng):
+    """The staging pool must NEVER corrupt shipped batches — on this
+    zero-copy CPU backend every aliased slot is retired instead of
+    reused, and the data of every batch (two epochs) stays exact."""
+    x = rng.normal(size=(160, 4)).astype(np.float64)
+    y = np.eye(3, dtype=np.float64)[rng.integers(0, 3, 160)]
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(features=x, labels=y, batch_size=16),
+        depth=2, dtype="float32")
+    for _ in range(2):
+        for i, b in enumerate(it):
+            np.testing.assert_array_equal(
+                np.asarray(b.features),
+                x[i * 16:(i + 1) * 16].astype(np.float32))
+            np.testing.assert_array_equal(
+                np.asarray(b.labels),
+                y[i * 16:(i + 1) * 16].astype(np.float32))
+
+
+def test_staging_pool_is_private_to_each_iteration(rng):
+    """Regression: the staging pool was shared per-instance, so a stale
+    producer thread that outlived an early-broken epoch by one batch
+    could stage into the SAME slots as the next epoch's producer and
+    overwrite a buffer whose transfer was still in flight. Each __iter__
+    must own a fresh pool (the stale producer keeps its old one), and
+    data after an early break must stay exact."""
+    x = rng.normal(size=(160, 4)).astype(np.float64)
+    y = np.eye(3, dtype=np.float64)[rng.integers(0, 3, 160)]
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(features=x, labels=y, batch_size=16),
+        depth=2, dtype="float32")
+    for b in it:                     # early break: producer may still be
+        break                        # one batch deep in its epoch
+    pool_first = it._staging
+    it.reset()
+    for i, b in enumerate(it):
+        np.testing.assert_array_equal(
+            np.asarray(b.features),
+            x[i * 16:(i + 1) * 16].astype(np.float32))
+    assert it._staging is not pool_first
+
+
+def test_staging_pool_reuses_buffers_on_copying_backend():
+    """Pool mechanics against a fake COPYING backend: allocations stop at
+    the slot count, every rotated slot waits for its previous transfer,
+    and an alias-suspected slot is retired, never overwritten."""
+    from deeplearning4j_tpu.datasets.prefetch import (_NEVER_REUSE,
+                                                      _StagingPool)
+
+    class Copied:
+        def __init__(self):
+            self.blocked = False
+
+        def devices(self):
+            return [type("D", (), {"platform": "tpu"})()]
+
+        def block_until_ready(self):
+            self.blocked = True
+
+    pool = _StagingPool(3)
+    a = np.arange(8, dtype=np.float64)
+    fakes = []
+    for i in range(7):
+        slot = pool.stage(a + i, np.float32)
+        np.testing.assert_array_equal(slot[0], (a + i).astype(np.float32))
+        fake = Copied()
+        pool.mark(slot, fake)
+        fakes.append(fake)
+    assert pool.allocations == 3
+    # slots rotated 4 times; each rotation blocked on the prior transfer
+    assert sum(f.blocked for f in fakes) == 4
+
+    class Aliased:
+        def devices(self):
+            return [type("D", (), {"platform": "cpu"})()]
+
+        def unsafe_buffer_pointer(self):
+            return self.buf.ctypes.data
+
+    pool2 = _StagingPool(2)
+    s1 = pool2.stage(a, np.float32)
+    al = Aliased()
+    al.buf = s1[0]
+    pool2.mark(s1, al)
+    assert s1[1] is _NEVER_REUSE
+    buf_before = s1[0]
+    pool2.stage(a + 1, np.float32)      # fills slot 2
+    pool2.mark(pool2.stage(a + 2, np.float32), Copied())  # retires slot 1
+    # the aliased buffer was left untouched (the device array owns it)
+    np.testing.assert_array_equal(buf_before, a.astype(np.float32))
+    assert pool2.allocations == 3

@@ -68,9 +68,17 @@ def summarize(events: List[dict], by: str = "path") -> List[dict]:
             key = e.get("args", {}).get("path") or name
             # a non-span event (e.g. a backend_compile attributed to the
             # span it happened under) gets its own bucket beneath that
-            # span's path instead of inflating the span's numbers
+            # span's path instead of inflating the span's numbers; a
+            # per-bucket collective event (cat=collective with a bucket
+            # attr, from parallel/overlap.profile_schedule) additionally
+            # keys on its bucket id so each bucket's all-reduce cost
+            # reads as its own phase
             if e.get("cat", "span") != "span":
-                key = f"{key}/[{name}]" if key != name else f"[{name}]"
+                label = name
+                if (e.get("cat") == "collective"
+                        and e.get("args", {}).get("bucket") is not None):
+                    label = f"{name}:{e['args']['bucket']}"
+                key = f"{key}/[{label}]" if key != name else f"[{label}]"
         else:
             key = name
         groups.setdefault(key, []).append(e.get("dur", 0) / 1e3)

@@ -77,6 +77,19 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    a fresh XLA program at request time):
                                    req/s + p99 latency at equal offered
                                    load, + the bucketed_speedup ratio
+  - generate_tokens_per_sec        closed-loop concurrent clients generating
+                                   through serving/generation (paged
+                                   KV-cache decode, AOT-warmed prefill +
+                                   decode-step programs): continuous
+                                   batching (decode_slots=8) vs
+                                   one-request-at-a-time decode
+                                   (decode_slots=1) at equal offered load —
+                                   aggregate + per-user tokens/sec,
+                                   time-to-first-token p50/p99, and the
+                                   continuous_speedup ratio (acceptance:
+                                   >=3x); nonzero steady-state XLA
+                                   compiles in either window invalidate
+                                   the row (tier-1 smoke asserts zero)
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
                                    #4), gated on (a) a probe-loss decrease
                                    with a margin far above noise and (b) a
@@ -124,6 +137,8 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
 Env knobs: BENCH_BATCH, BENCH_IMG, BENCH_STEPS, BENCH_SKIP_EXTRAS=1,
 BENCH_SERVING_S (per-mode closed-loop window, default 6),
 BENCH_SERVING_CLIENTS (default 8),
+BENCH_GEN_S (per-mode generation window, default 6),
+BENCH_GEN_CLIENTS (default 8),
 BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1560),
 BENCH_ROW_CAP_S (per-row SIGALRM cap; default 300), BENCH_PEAK_TFLOPS,
 BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU, BENCH_REPEATS (timed windows per
@@ -847,6 +862,99 @@ def bench_serving(duration=None, clients=None, sizes=(1, 2, 3, 5, 8, 13,
     out["note"] = (f"{clients} closed-loop clients, {duration:.0f}s/mode, "
                    f"request sizes {list(sizes)}: bucket ladder 8/32/64 "
                    "AOT-warmed vs legacy per-shape-recompile batcher")
+    return out
+
+
+def bench_generate(duration=None, clients=None, *, decode_slots=8,
+                   max_new=24, prompt_len=8):
+    """generate_tokens_per_sec: closed-loop concurrent clients generating
+    through the serving/generation engine (paged KV-cache decode, all
+    prefill/decode programs AOT-warmed). Two modes at equal offered load:
+    (a) continuous batching — ``decode_slots`` in-flight sequences advance
+    together, freed slots backfilled from the queue at step boundaries —
+    and (b) one-request-at-a-time decode (decode_slots=1, the naive serial
+    loop every per-user token would otherwise pay). Reports aggregate and
+    per-user tokens/sec, time-to-first-token p50/p99, and the
+    continuous_speedup ratio (ISSUE 9 acceptance: >= 3x on this rig); a
+    nonzero steady-state XLA compile count in either window marks the row
+    invalid (the tier-1 bench_smoke guard asserts zero). Wall-clock
+    chained timing is CORRECT here — host scheduling is the thing under
+    test."""
+    import threading as _threading
+
+    from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+    from deeplearning4j_tpu.serving import (GenerationEngine,
+                                            xla_compile_count)
+
+    duration = duration or float(os.environ.get("BENCH_GEN_S", "6"))
+    clients = clients or int(os.environ.get("BENCH_GEN_CLIENTS", "8"))
+    net = transformer_lm(vocab_size=128, d_model=64, n_heads=2, n_blocks=2,
+                         max_length=64, seed=123, dtype="float32",
+                         token_input=True).init()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, size=prompt_len).tolist()
+               for _ in range(16)]
+
+    def closed_loop(eng):
+        """clients threads, each generate->wait->generate until the window
+        closes; returns (tokens_emitted, completed_requests)."""
+        done = {"tok": 0, "req": 0}
+        lock = _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(tid):
+            k, tok, req = tid, 0, 0
+            while time.perf_counter() < stop_at:
+                toks, _ = eng.generate(prompts[k % len(prompts)],
+                                       max_tokens=max_new, timeout=60.0)
+                tok += len(toks)
+                req += 1
+                k += 1
+            with lock:
+                done["tok"] += tok
+                done["req"] += req
+
+        threads = [_threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done["tok"], done["req"]
+
+    out = {}
+    modes = (("continuous", decode_slots), ("sequential", 1))
+    for label, slots in modes:
+        eng = GenerationEngine(
+            net, model_name="lm", block_len=16, max_seq_len=64,
+            decode_slots=slots, queue_limit=4096,
+            prefill_batches=(1, 2, 4) if slots > 1 else (1,))
+        c0 = xla_compile_count()
+        tok, req = closed_loop(eng)
+        compiles = xla_compile_count() - c0
+        snap = eng.metrics()["lm"]
+        eng.stop()
+        out[f"{label}_tokens_per_sec"] = round(tok / duration, 1)
+        out[f"{label}_tokens_per_sec_per_user"] = round(
+            tok / duration / clients, 2)
+        out[f"{label}_ttft_p50_ms"] = snap["ttft_ms"]["p50"]
+        out[f"{label}_ttft_p99_ms"] = snap["ttft_ms"]["p99"]
+        out[f"{label}_requests"] = req
+        out[f"{label}_steady_state_compiles"] = compiles
+        if compiles:
+            out["invalid_reason"] = (
+                f"{label}: {compiles} steady-state compiles — the "
+                "zero-recompile contract is violated, speedup numbers "
+                "are not trustworthy")
+    if out["sequential_tokens_per_sec"]:
+        out["continuous_speedup"] = round(
+            out["continuous_tokens_per_sec"]
+            / out["sequential_tokens_per_sec"], 3)
+    out["note"] = (f"{clients} closed-loop clients, {duration:.0f}s/mode, "
+                   f"prompt {prompt_len} tokens, max_new {max_new}, "
+                   f"2-block d=64 LM: continuous batching "
+                   f"(decode_slots={decode_slots}) vs one-request-at-a-time "
+                   "decode, both on the paged KV-cache AOT-warmed path")
     return out
 
 
@@ -1775,6 +1883,7 @@ def main():
             ("dispatch_bound_steps_per_sec", bench_dispatch_bound),
             ("telemetry_overhead", bench_telemetry_overhead),
             ("serving_throughput", bench_serving),
+            ("generate_tokens_per_sec", bench_generate),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overlap", bench_collective_overlap),
             ("collective_overhead_by_mesh", bench_collective_overhead),

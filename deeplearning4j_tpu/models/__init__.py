@@ -1,3 +1,5 @@
+from .decode import (LSTMDecodeSpec, TransformerDecodeSpec, naive_generate,
+                     naive_generate_lstm)
 from .lenet import digits_cnn, lenet
 from .pretrained import adler32_of, fetch_cached, init_pretrained
 from .zoo import alexnet, resnet50, simple_cnn, vgg16, vgg19
@@ -9,4 +11,6 @@ __all__ = [
     "digits_cnn", "googlenet", "inception_resnet_v1", "init_pretrained", "lenet",
     "resnet50", "simple_cnn", "text_generation_lstm", "transformer_lm",
     "vgg16", "vgg19",
+    "TransformerDecodeSpec", "LSTMDecodeSpec", "naive_generate",
+    "naive_generate_lstm",
 ]

@@ -25,17 +25,23 @@ Pillars:
 from .buckets import BucketLadder
 from .batcher import ShapeBucketedBatcher
 from .engine import InferenceEngine
-from .errors import (DeadlineExceededError, DrainingError, QueueFullError,
+from .errors import (BlockPoolExhaustedError, DeadlineExceededError,
+                     DrainingError, GenerationClosedError, QueueFullError,
                      ServingError, ShapeMismatchError, UnknownModelError)
 from .metrics import ServingMetrics, xla_compile_count
 from .http import ServingHTTPServer
 from .programs import ProgramSet
 from .registry import ModelRegistry, load_net
+from .generation import (GenerationConfig, GenerationEngine,
+                         GenerationMetrics, TokenStream)
 
 __all__ = [
     "BucketLadder", "ShapeBucketedBatcher", "InferenceEngine",
     "ServingError", "QueueFullError", "DrainingError",
     "DeadlineExceededError", "UnknownModelError", "ShapeMismatchError",
+    "BlockPoolExhaustedError", "GenerationClosedError",
     "ServingMetrics", "xla_compile_count", "ServingHTTPServer",
     "ProgramSet", "ModelRegistry", "load_net",
+    "GenerationEngine", "GenerationConfig", "GenerationMetrics",
+    "TokenStream",
 ]

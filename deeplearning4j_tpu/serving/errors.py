@@ -27,3 +27,21 @@ class UnknownModelError(ServingError):
 class ShapeMismatchError(ServingError):
     """Request feature shape/dtype doesn't match the model's warmed
     programs (400) — the ladder is compiled for one trailing shape."""
+
+
+class BlockPoolExhaustedError(QueueFullError):
+    """Generation admission refused: the paged KV-cache block pool cannot
+    supply the blocks the request needs (429, like its parent).
+    ``retryable=False`` marks the PERMANENT flavor — the request needs more
+    blocks than the pool has at all, so retrying can never help and
+    http.py omits the ``retry_after_ms`` hint."""
+
+    def __init__(self, *args, retryable: bool = True):
+        super().__init__(*args)
+        self.retryable = retryable
+
+
+class GenerationClosedError(ServingError):
+    """The generation was terminated before completing (shutdown or
+    internal failure); streaming callers see the stream close with this
+    as the error, blocking callers get it raised (500/503)."""

@@ -1,0 +1,41 @@
+"""serving/generation — autoregressive decode subsystem.
+
+Paged-KV-cache incremental decode with continuous batching and per-token
+streaming (ROADMAP open item 4): the serving engine's
+precompiled-fixed-shape-program discipline (cuDNN's shape-specialized
+primitives, arXiv:1410.0759, applied to whole XLA programs) extended to
+generation, where the working set GROWS per token. The trick is vLLM-style
+paging: the KV cache is a fixed block pool + per-sequence block tables, so
+context growth is block allocation — no array ever changes shape, nothing
+ever recompiles after warm-up.
+
+Pillars:
+  - kvcache.py    block pool, free-list allocator, gather/scatter, the
+                  PagedStore bridge into models/decode.py
+  - programs.py   GenerationConfig + AOT-warmed prefill (bucketed) and
+                  decode-step executables, buffer-donated cache,
+                  jit-carried PRNG
+  - sampling.py   greedy / temperature / top-k, in-program
+  - scheduler.py  continuous batching: step-boundary admission, slot
+                  backfill, TokenStream per request, cohort-pinned
+                  hot-swap, armed RecompileDetector
+  - metrics.py    TTFT, decode-step latency, tokens/sec, slot occupancy,
+                  block usage -> GET /metrics + telemetry registry
+  - engine.py     GenerationEngine facade (multi-model, hot-swap, drain)
+
+Model math lives in models/decode.py (TransformerDecodeSpec /
+LSTMDecodeSpec + the naive_generate bit-exactness reference); the HTTP
+streaming surface is serving/http.py (POST /generate).
+"""
+from .engine import GenerationEngine
+from .kvcache import BlockAllocator, PagedStore, make_pools
+from .metrics import GenerationMetrics
+from .programs import GenerationConfig, GenerationProgramSet
+from .sampling import sample_tokens
+from .scheduler import ModelRuntime, TokenStream
+
+__all__ = [
+    "GenerationEngine", "GenerationConfig", "GenerationProgramSet",
+    "GenerationMetrics", "ModelRuntime", "TokenStream", "BlockAllocator",
+    "PagedStore", "make_pools", "sample_tokens",
+]

@@ -1,0 +1,227 @@
+"""GenerationEngine: the autoregressive-serving facade.
+
+The decode-side sibling of ``serving.InferenceEngine``: multi-model
+registry, AOT warm-up, continuous-batching scheduling (ModelRuntime per
+model), per-token streaming, zero-downtime hot-swap with the
+finish-on-old-params cutover rule, drain-then-stop lifecycle.
+
+    eng = GenerationEngine(net, model_name="lm",
+                           block_len=16, max_seq_len=128, decode_slots=8)
+    tokens, reason = eng.generate([5, 7, 11], max_tokens=32)
+    for tok in eng.generate([5, 7, 11], max_tokens=32, stream=True):
+        ...                     # per-token, TTFT = one prefill away
+
+Serve it over HTTP by passing ``generation=eng`` to
+``serving.ServingHTTPServer`` (POST /generate streams NDJSON chunks).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+from ..errors import DrainingError, UnknownModelError
+from ..registry import load_net
+from .metrics import GenerationMetrics
+from .programs import GenerationConfig, GenerationProgramSet
+from .scheduler import ModelRuntime, TokenStream
+
+
+class GenerationEngine:
+    def __init__(self, net=None, *, model_name: str = "default",
+                 config: Optional[GenerationConfig] = None,
+                 adapter: str = "auto", warm: bool = True,
+                 watch_recompiles: bool = True, **config_kwargs):
+        self._models: Dict[str, ModelRuntime] = {}
+        self._default: Optional[str] = None
+        self._lock = threading.Lock()
+        self._draining = False
+        self._trace_count = 0
+        self._watch = watch_recompiles
+        if net is not None:
+            self.add_model(model_name, net, config=config, adapter=adapter,
+                           warm=warm, default=True, **config_kwargs)
+
+    # ------------------------------------------------------------------ models
+    def add_model(self, name: str, net, *,
+                  config: Optional[GenerationConfig] = None,
+                  adapter: str = "auto", warm: bool = True,
+                  default: bool = False, **config_kwargs) -> ModelRuntime:
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"generation model '{name}' already "
+                                 "registered (use hot_swap to replace)")
+        cfg = config or GenerationConfig(**config_kwargs)
+        self._pause_detectors()
+        try:
+            ps = GenerationProgramSet(net, config=cfg, adapter=adapter,
+                                      trace_hook=self._on_trace)
+            if warm:
+                ps.warm()
+        finally:
+            self._resume_detectors()
+        rt = ModelRuntime(name, ps, GenerationMetrics(name=name),
+                          watch_recompiles=self._watch)
+        with self._lock:
+            if name in self._models:      # lost a registration race
+                rt.stop(drain=False, timeout=1.0)
+                raise ValueError(f"generation model '{name}' already "
+                                 "registered")
+            self._models[name] = rt
+            if default or self._default is None:
+                self._default = name
+        return rt
+
+    def remove_model(self, name: str) -> None:
+        rt = self._get(name)
+        with self._lock:
+            self._models.pop(name, None)
+            if self._default == name:
+                self._default = next(iter(self._models), None)
+        rt.stop(drain=True)
+
+    def _get(self, name: Optional[str]) -> ModelRuntime:
+        with self._lock:
+            key = name or self._default
+            if key is None or key not in self._models:
+                raise UnknownModelError(
+                    f"no generation model {key!r} (registered: "
+                    f"{sorted(self._models)})")
+            return self._models[key]
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    @property
+    def default_name(self) -> Optional[str]:
+        return self._default
+
+    # ------------------------------------------------------------- generation
+    def generate(self, prompt, *, model: Optional[str] = None,
+                 max_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 stop: Sequence[int] = (),
+                 timeout: Optional[float] = None, stream: bool = False
+                 ) -> Union[TokenStream, Tuple[list, str]]:
+        """Generate up to ``max_tokens`` tokens after ``prompt`` (a 1-D int
+        token-id sequence). ``stream=True`` returns a TokenStream to
+        iterate; otherwise blocks and returns (tokens, finish_reason).
+        ``temperature<=0`` is greedy; ``top_k<=0`` disables the top-k cut;
+        ``stop`` token ids terminate generation (not emitted)."""
+        if self._draining:
+            raise DrainingError("generation engine is draining")
+        rt = self._get(model)
+        ts = rt.submit(prompt,
+                       max_new=(max_tokens if max_tokens is not None
+                                else rt.config.default_max_tokens),
+                       temperature=temperature, top_k=top_k, stop=stop,
+                       timeout=timeout)
+        if stream:
+            return ts
+        return ts.result()
+
+    # --------------------------------------------------------------- hot-swap
+    def hot_swap(self, name: str, net_or_path) -> int:
+        """Replace model ``name`` with zero downtime. Cutover rule:
+        generations in flight at swap time FINISH on the old params (their
+        cohort keeps its program set and cache pool until it drains); every
+        admission after the swap runs the new params. Same-architecture
+        swaps reuse the compiled executables; changed architectures warm a
+        full new program set BEFORE the cutover. Returns the new version."""
+        rt = self._get(name)
+        net = load_net(net_or_path) if isinstance(net_or_path, str) \
+            else net_or_path
+        with rt.swap_lock:
+            old = rt.active_ps
+            try:
+                new_ps = old.with_params_from(net)
+            except ValueError:
+                self._pause_detectors()
+                try:
+                    new_ps = GenerationProgramSet(
+                        net, config=old.config, adapter="auto",
+                        trace_hook=self._on_trace).warm()
+                finally:
+                    self._resume_detectors()
+            rt.active_ps = new_ps         # atomic: next admission cohort
+            rt.version += 1
+            rt.metrics.record_swap()
+            return rt.version
+
+    def reload_from_checkpoint(self, name: str, path: str) -> int:
+        return self.hot_swap(name, load_net(path))
+
+    # ---------------------------------------------------------- observability
+    def metrics(self) -> Dict[str, dict]:
+        with self._lock:
+            rts = list(self._models.values())
+        return {rt.name: rt.metrics.snapshot() for rt in rts}
+
+    def models(self) -> Dict[str, dict]:
+        with self._lock:
+            rts = list(self._models.values())
+        return {rt.name: {
+            "version": rt.version,
+            "adapter": rt.active_ps.adapter,
+            "warmed": rt.active_ps.warmed,
+            "decode_slots": rt.config.decode_slots,
+            "block_len": rt.config.block_len,
+            "capacity": rt.config.capacity,
+            "num_blocks": rt.config.num_blocks,
+            "prompt_rungs": list(rt.config.prompt_rungs),
+            "prefill_batches": list(rt.config.prefill_batches),
+            "in_flight": rt.in_flight,
+            "queue_depth": rt.queue_depth,
+        } for rt in rts}
+
+    def queue_depths(self) -> Dict[str, int]:
+        with self._lock:
+            rts = list(self._models.values())
+        return {rt.name: rt.queue_depth for rt in rts}
+
+    def publish_metrics(self, storage, session_id: str = "generation"):
+        with self._lock:
+            rts = list(self._models.values())
+        for rt in rts:
+            rt.metrics.publish(storage, session_id=session_id,
+                               worker_id=rt.name)
+
+    @property
+    def trace_count(self) -> int:
+        return self._trace_count
+
+    def _on_trace(self):
+        self._trace_count += 1
+
+    @staticmethod
+    def compile_count() -> int:
+        from ..metrics import xla_compile_count
+        return xla_compile_count()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _pause_detectors(self):
+        """Warm-up compiles are legitimate — keep them out of the armed
+        decode-loop recompile watchdogs."""
+        with self._lock:
+            rts = list(self._models.values())
+        for rt in rts:
+            if rt._det is not None:
+                rt._det.__exit__(None, None, None)
+
+    def _resume_detectors(self):
+        with self._lock:
+            rts = list(self._models.values())
+        for rt in rts:
+            if rt._det is not None:
+                rt._det.__enter__()
+
+    # ------------------------------------------------------------- lifecycle
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
+        with self._lock:
+            self._draining = True
+            rts = list(self._models.values())
+        for rt in rts:
+            rt.stop(drain=drain, timeout=timeout)

@@ -1,0 +1,134 @@
+"""Paged KV cache: fixed-size block pool + per-sequence block tables.
+
+The central shape discipline of the decode subsystem: the cache is ONE pair
+of pool arrays per model —
+
+    k_pool / v_pool : [n_layers, num_blocks, block_len, n_heads, head_dim]
+
+— and a sequence's cache is the set of pool blocks its (host-side) block
+table points at. "Growing" a sequence's context is block *allocation*, a
+bookkeeping edit to an int32 table; no device array ever changes shape, so
+nothing ever recompiles (the vLLM PagedAttention idea fused with the
+repo's AOT-warmed-program discipline).
+
+Block 0 is the reserved TRASH block: inactive decode slots and the unused
+tail of a prefill's table all point at it, so the fixed-shape scatter always
+has a legal destination and garbage lands where nothing ever reads it
+(attention masks it out regardless).
+
+Host side: ``BlockAllocator`` — a free-list over block ids 1..num_blocks-1.
+Device side: pure gather/scatter helpers used inside the jitted prefill and
+decode programs; ``PagedStore`` adapts them to the ``models.decode.KVStore``
+protocol.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..errors import BlockPoolExhaustedError
+
+
+class BlockAllocator:
+    """Free-list allocator over the pool's usable blocks (ids 1..n-1; block
+    0 is the trash block). Not thread-safe by itself — the scheduler owns
+    it from its single dispatch thread."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved trash)")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+
+    @property
+    def total_usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.total_usable - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise BlockPoolExhaustedError(
+                f"block pool exhausted: need {n} blocks, "
+                f"{len(self._free)}/{self.total_usable} free — retry after "
+                f"in-flight generations release their blocks")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids: Sequence[int]) -> None:
+        for b in ids:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"free of invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(int(b))
+
+
+def make_pools(n_layers: int, num_blocks: int, block_len: int,
+               n_heads: int, head_dim: int, dtype) -> Tuple:
+    """Zero-filled (k_pool, v_pool)."""
+    shape = (n_layers, num_blocks, block_len, n_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def prefill_scatter(pool, layer_kv, tables):
+    """Write a prefill's K or V for one layer into the pool.
+
+    pool      [n_layers, nb, blk, H, Dh] (functional update)
+    layer_kv  list of [P, L, H, Dh] per layer (L % blk == 0)
+    tables    [P, max_blocks] int32 — first L//blk entries are the
+              sequence's blocks (rest point at trash block 0).
+    """
+    P, L, H, Dh = layer_kv[0].shape
+    blk = pool.shape[2]
+    nblk = L // blk
+    for i, kv in enumerate(layer_kv):
+        pool = pool.at[i, tables[:, :nblk]].set(
+            kv.reshape(P, nblk, blk, H, Dh))
+    return pool
+
+
+class PagedStore:
+    """``models.decode.KVStore`` over the paged pools for ONE decode step.
+
+    Scatter-then-gather: the current token's K/V lands in its block slot
+    first, then the gathered context (position-ordered, so attention row
+    ``pos`` is bit-identical to the naive causal row) includes it.
+    Inactive rows scatter to the trash block."""
+
+    def __init__(self, k_pool, v_pool, tables, pos, active, block_len: int):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.tables = tables              # [S, max_blocks] int32
+        self.pos = pos                    # [S] int32
+        self.active = active              # [S] bool
+        self.block_len = int(block_len)
+        S, mb = tables.shape
+        self._ctx_len = mb * self.block_len
+        bid = jnp.take_along_axis(tables, (pos // self.block_len)[:, None],
+                                  axis=1)[:, 0]
+        self._bid = jnp.where(active, bid, 0)      # trash for idle slots
+        self._off = jnp.where(active, pos % self.block_len, 0)
+        self._mask = (jnp.arange(self._ctx_len)[None, :] <= pos[:, None])
+
+    def put_get(self, i: int, k_tok, v_tok):
+        S = k_tok.shape[0]
+        self.k_pool = self.k_pool.at[i, self._bid, self._off].set(k_tok)
+        self.v_pool = self.v_pool.at[i, self._bid, self._off].set(v_tok)
+        H, Dh = k_tok.shape[-2:]
+
+        def gathered(pool):
+            ctx = pool[i][self.tables]          # [S, mb, blk, H, Dh]
+            return ctx.reshape(S, self._ctx_len, H, Dh).transpose(0, 2, 1, 3)
+
+        return gathered(self.k_pool), gathered(self.v_pool), self._mask
+
+    @property
+    def pools(self):
+        return self.k_pool, self.v_pool

@@ -1,0 +1,188 @@
+"""Generation observability: per-model token/latency/occupancy counters.
+
+Same contract as ``serving.metrics.ServingMetrics`` — a local snapshot dict
+(the ``GET /metrics`` payload) with every recording mirrored into the shared
+telemetry registry under ``generation.<model>.*`` so training, forward
+serving and decode land on ONE reporting surface. Adds the decode-specific
+signals: time-to-first-token, per-decode-step latency, per-user streaming
+rate, slot occupancy, block-pool usage, and the decode loop's own
+recompile count (the RecompileDetector the scheduler keeps armed after
+warm-up).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict
+
+from ...telemetry import get_registry
+from ...telemetry.registry import _percentile
+
+
+class GenerationMetrics:
+    def __init__(self, window: int = 4096, name: str = "default",
+                 registry=None):
+        self._lock = threading.Lock()
+        self.name = name
+        self._registry = registry
+        self._ttft_ms = deque(maxlen=window)
+        self._step_ms = deque(maxlen=window)
+        self._tok_t = deque(maxlen=window)       # emission timestamps
+        self.requests = 0
+        self.tokens_out = 0
+        self.prefills = 0
+        self.prefill_rows = 0
+        self.decode_steps = 0
+        self.decode_slot_steps = 0              # active slots summed per step
+        self.finished: Dict[str, int] = {}
+        self.rejected: Dict[str, int] = {"full": 0, "exhausted": 0,
+                                         "draining": 0, "deadline": 0,
+                                         "error": 0}
+        self.swaps = 0
+        self.decode_recompiles = 0
+        self.slots = 0
+        self.blocks_total = 0
+        self._t0 = time.monotonic()
+        self._rate_t = self._t0
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None else get_registry()
+
+    # ------------------------------------------------------------- recording
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests += 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.requests").inc()
+
+    def record_prefill(self, rows: int, ttft_ms_per_row,
+                       emitted: int = 0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.prefills += 1
+            self.prefill_rows += rows
+            self._ttft_ms.extend(ttft_ms_per_row)
+            self.tokens_out += emitted          # each row's FIRST token
+            self._tok_t.extend([now] * emitted)
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.prefills").inc()
+            if emitted:
+                reg.counter(
+                    f"generation.{self.name}.tokens_out").inc(emitted)
+            h = reg.histogram(f"generation.{self.name}.ttft_ms")
+            for v in ttft_ms_per_row:
+                h.observe(v)
+
+    def record_decode_step(self, step_ms: float, active_slots: int,
+                           emitted: int, *, slots: int,
+                           blocks_used: int, blocks_total: int,
+                           queue_depth: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.decode_steps += 1
+            self.decode_slot_steps += active_slots
+            self.tokens_out += emitted
+            self._step_ms.append(step_ms)
+            self._tok_t.extend([now] * emitted)
+            self.slots = slots
+            self.blocks_total = blocks_total
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.decode_steps").inc()
+            reg.counter(f"generation.{self.name}.tokens_out").inc(emitted)
+            reg.histogram(
+                f"generation.{self.name}.decode_step_ms").observe(step_ms)
+            reg.gauge(f"generation.{self.name}.slot_occupancy").set(
+                active_slots / slots if slots else 0.0)
+            reg.gauge(f"generation.{self.name}.blocks_in_use").set(
+                blocks_used)
+            reg.gauge(f"generation.{self.name}.queue_depth").set(queue_depth)
+            # throttled: the rate scan over the timestamp ring is not free
+            # and the decode step is the serving hot loop
+            if now - self._rate_t >= 0.5:
+                self._rate_t = now
+                reg.gauge(f"generation.{self.name}.tokens_per_sec").set(
+                    self._recent_tokens_per_sec(now))
+
+    def record_finish(self, reason: str) -> None:
+        with self._lock:
+            self.finished[reason] = self.finished.get(reason, 0) + 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(
+                f"generation.{self.name}.finished.{reason}").inc()
+
+    def record_rejection(self, kind: str) -> None:
+        with self._lock:
+            self.rejected[kind] = self.rejected.get(kind, 0) + 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.rejected.{kind}").inc()
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.hot_swaps").inc()
+
+    def record_recompile(self, n: int) -> None:
+        with self._lock:
+            self.decode_recompiles = n
+
+    def _recent_tokens_per_sec(self, now: float, window_s: float = 5.0):
+        if not self._tok_t:
+            return 0.0
+        cut = now - window_s
+        # the ring is count-bounded: at high rates it evicts timestamps
+        # still inside the window — measure over the span actually
+        # retained, or the gauge saturates at maxlen/window_s
+        oldest = self._tok_t[0]
+        if oldest > cut:                       # evicted inside the window
+            cut = oldest
+            span = max(now - cut, 1e-3)
+        else:
+            span = max(min(window_s, now - self._t0), 1e-3)
+        n = 0
+        for t in reversed(self._tok_t):
+            if t < cut:
+                break
+            n += 1
+        return round(n / span, 2)
+
+    # ------------------------------------------------------------- reporting
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            ttft = sorted(self._ttft_ms)
+            step = sorted(self._step_ms)
+            occ = (self.decode_slot_steps / (self.decode_steps * self.slots)
+                   if self.decode_steps and self.slots else 0.0)
+            return {
+                "requests": self.requests,
+                "tokens_out": self.tokens_out,
+                "prefills": self.prefills,
+                "prefill_rows": self.prefill_rows,
+                "decode_steps": self.decode_steps,
+                "ttft_ms": {"p50": round(_percentile(ttft, 0.50), 3),
+                            "p99": round(_percentile(ttft, 0.99), 3)},
+                "decode_step_ms": {"p50": round(_percentile(step, 0.50), 3),
+                                   "p99": round(_percentile(step, 0.99), 3)},
+                "slot_occupancy": round(occ, 4),
+                "tokens_per_sec_recent": self._recent_tokens_per_sec(now),
+                "finished": dict(self.finished),
+                "rejected": dict(self.rejected),
+                "hot_swaps": self.swaps,
+                "decode_recompiles": self.decode_recompiles,
+                "uptime_s": round(now - self._t0, 1),
+            }
+
+    def publish(self, storage, session_id: str = "generation",
+                worker_id: str = "default") -> dict:
+        snap = self.snapshot()
+        storage.put_update(session_id, worker_id, snap)
+        return snap

@@ -1,0 +1,323 @@
+"""AOT-warmed generation programs: bucketed prefill + ONE decode step.
+
+Extends the ``serving/programs.py`` discipline to autoregressive decode:
+every program the steady-state loop can ever need is lowered and compiled at
+``warm()`` —
+
+  - one **prefill** executable per (admission-batch rung P, prompt rung L):
+    padded prompt -> per-position logits via the graph's own ``apply_fn``
+    (bit-identical to ``net.output``), K/V scattered into the paged pools,
+    first token sampled in-program;
+  - one **decode-step** executable: one token per in-flight slot, gather via
+    block tables, scatter the step's K/V, sample the next token — cache
+    buffers donated so the pool updates in place on real devices.
+
+Params/state are arguments, not constants, so hot-swap reuses executables
+exactly as the forward-serving ProgramSet does (``with_params_from``).
+The PRNG key is carried through every program and split in-program.
+
+Model support is adapter-based: ``models.decode.TransformerDecodeSpec``
+(paged KV cache) and ``models.decode.LSTMDecodeSpec`` (the cache is the
+fixed-shape recurrent state; the block machinery degenerates to zero-block
+bookkeeping but the program/scheduler contract is identical).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.decode import LSTMDecodeSpec, TransformerDecodeSpec
+from ..programs import _arch_key, _tree_signature
+from .kvcache import PagedStore, make_pools, prefill_scatter
+from .sampling import sample_tokens
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class GenerationConfig:
+    """Shape/capacity plan for one generation model. Everything here is
+    trace-time static — the warmed program set covers the full plan, so
+    admission-time work is array fills only."""
+    block_len: int = 16
+    max_seq_len: int = 128            # prompt + generated tokens, per request
+    decode_slots: int = 8             # in-flight sequences per decode step
+    prefill_batches: Tuple[int, ...] = (1, 2, 4)
+    prompt_rungs: Optional[Tuple[int, ...]] = None   # default: (capacity,)
+    num_blocks: Optional[int] = None  # pool size; default: full occupancy + 1
+    queue_limit: int = 256
+    default_timeout_s: float = 30.0
+    default_max_tokens: int = 32
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.block_len < 1 or self.decode_slots < 1:
+            raise ValueError("block_len and decode_slots must be >= 1")
+        self.capacity = _ceil_to(self.max_seq_len, self.block_len)
+        self.blocks_per_seq = self.capacity // self.block_len
+        self.prefill_batches = tuple(sorted(set(
+            int(b) for b in self.prefill_batches)))
+        if not self.prefill_batches or self.prefill_batches[0] < 1:
+            raise ValueError("prefill_batches must be positive")
+        rungs = self.prompt_rungs or (self.capacity,)
+        rungs = tuple(sorted({min(_ceil_to(int(r), self.block_len),
+                                  self.capacity) for r in rungs}))
+        if rungs[-1] != self.capacity:
+            rungs = rungs + (self.capacity,)
+        self.prompt_rungs = rungs
+        if self.num_blocks is None:
+            self.num_blocks = self.decode_slots * self.blocks_per_seq + 1
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is trash)")
+
+    @property
+    def max_prompt_len(self) -> int:
+        return self.prompt_rungs[-1]
+
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return math.ceil((prompt_len + max_new) / self.block_len)
+
+    def prefill_rung(self, n: int) -> int:
+        for b in self.prefill_batches:
+            if n <= b:
+                return b
+        return self.prefill_batches[-1]
+
+    def prompt_rung(self, plen: int) -> int:
+        for r in self.prompt_rungs:
+            if plen <= r:
+                return r
+        raise ValueError(f"prompt length {plen} exceeds the largest prompt "
+                         f"rung {self.prompt_rungs[-1]}")
+
+
+def _donate_argnums() -> Tuple[int, ...]:
+    # cache donation is a no-op (with a warning) on the CPU test backend
+    return (2,) if jax.default_backend() in ("tpu", "gpu") else ()
+
+
+class GenerationProgramSet:
+    """One model version's warmed generation executables + its params.
+
+    Immutable after ``warm()`` — the engine swaps whole sets atomically and
+    the scheduler pins each in-flight cohort to the set it was admitted
+    under (the hot-swap cutover rule)."""
+
+    def __init__(self, net, *, config: GenerationConfig,
+                 adapter: str = "auto",
+                 trace_hook: Optional[Callable[[], None]] = None):
+        self.net = net
+        self.config = config
+        self._trace_hook = trace_hook
+        self.adapter = self._resolve_adapter(net, adapter)
+        self.spec = (TransformerDecodeSpec(net) if self.adapter == "paged"
+                     else LSTMDecodeSpec(net))
+        self.params = jax.tree.map(jnp.asarray, net.params)
+        self.state = jax.tree.map(jnp.asarray, net.state)
+        self.dtype = self.spec.dtype
+        self.vocab = self.spec.vocab
+        self.signature = (_tree_signature(self.params),
+                          _tree_signature(self.state), _arch_key(net),
+                          self.adapter, config.block_len, config.capacity,
+                          config.decode_slots, config.prefill_batches,
+                          config.prompt_rungs, config.num_blocks)
+        self._compiled: Dict[Any, Any] = {}
+        if self.adapter == "state":
+            self._init_states = self.spec.init_states(config.decode_slots + 1)
+
+    @staticmethod
+    def _resolve_adapter(net, adapter: str) -> str:
+        if adapter in ("paged", "transformer"):
+            return "paged"
+        if adapter in ("state", "lstm"):
+            return "state"
+        if adapter != "auto":
+            raise ValueError(f"unknown adapter {adapter!r}")
+        # ComputationGraph transformer vs MultiLayerNetwork recurrent stack
+        if hasattr(net, "vertex_names") and "b0_attn" in net.vertex_names:
+            return "paged"
+        return "state"
+
+    # ---------------------------------------------------------------- cache
+    def make_cache(self):
+        """Fresh cache pytree: (k_pool, v_pool) for the paged adapter, the
+        zeroed recurrent-state carry (decode_slots + 1 rows, last row is
+        the prefill-padding trash slot) for the state adapter."""
+        c = self.config
+        if self.adapter == "paged":
+            return make_pools(self.spec.n_blocks, c.num_blocks, c.block_len,
+                              self.spec.n_heads, self.spec.head_dim,
+                              self.dtype)
+        return jax.tree.map(jnp.zeros_like, self._init_states)
+
+    def fresh_key(self):
+        return jax.random.PRNGKey(self.config.seed)
+
+    # ------------------------------------------------------------- programs
+    def _prefill_fn(self):
+        spec = self.spec
+
+        def fn(params, state, cache, tokens, lengths, tables, slots, key,
+               temp, topk):
+            if self._trace_hook is not None:
+                self._trace_hook()
+            if self.adapter == "paged":
+                k_pool, v_pool = cache
+                logits, ks, vs = spec.prefill_forward(params, state, tokens)
+                k_pool = prefill_scatter(k_pool, ks, tables)
+                v_pool = prefill_scatter(v_pool, vs, tables)
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                tok, key = sample_tokens(last, key, temp, topk)
+                return tok, (k_pool, v_pool), key
+            P = tokens.shape[0]
+            zero = jax.tree.map(
+                lambda c: jnp.zeros((P,) + c.shape[1:], c.dtype), cache)
+            logits, final = spec.prefill_scan(params, state, tokens, lengths,
+                                              zero)
+            cache = jax.tree.map(lambda c, n: c.at[slots].set(n), cache,
+                                 final)
+            tok, key = sample_tokens(logits, key, temp, topk)
+            return tok, cache, key
+        return fn
+
+    def _decode_fn(self):
+        spec, blk = self.spec, self.config.block_len
+
+        def fn(params, state, cache, tokens, pos, tables, active, key,
+               temp, topk):
+            if self._trace_hook is not None:
+                self._trace_hook()
+            if self.adapter == "paged":
+                store = PagedStore(cache[0], cache[1], tables, pos, active,
+                                   blk)
+                logits = spec.decode_step(params, state, tokens, pos, store)
+                tok, key = sample_tokens(logits, key, temp, topk)
+                return tok, store.pools, key
+            S = tokens.shape[0]
+            cur = jax.tree.map(lambda c: c[:S], cache)
+            logits, new = spec.decode_step(params, state, tokens, cur)
+
+            def merge(c, n):
+                keep = active.reshape((S,) + (1,) * (n.ndim - 1))
+                return jnp.concatenate(
+                    [jnp.where(keep, n, c[:S]), c[S:]], axis=0)
+            cache = jax.tree.map(merge, cache, new)
+            tok, key = sample_tokens(logits, key, temp, topk)
+            return tok, cache, key
+        return fn
+
+    def _cache_spec(self):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self.make_cache())
+
+    def _key_spec(self):
+        k = self.fresh_key()
+        return jax.ShapeDtypeStruct(k.shape, k.dtype)
+
+    # --------------------------------------------------------------- warm-up
+    def warm(self) -> "GenerationProgramSet":
+        """Compile every prefill rung and the decode step; touch each once
+        so first traffic pays no one-time dispatch setup. NEVER called on
+        the decode hot path."""
+        c = self.config
+        i32 = jnp.int32
+        cache_spec, key_spec = self._cache_spec(), self._key_spec()
+        mb = c.blocks_per_seq
+        prefill = self._prefill_fn()
+        decode = self._decode_fn()
+        for P in c.prefill_batches:
+            for L in c.prompt_rungs:
+                jitted = jax.jit(prefill, donate_argnums=_donate_argnums())
+                self._compiled[("prefill", P, L)] = jitted.lower(
+                    self.params, self.state, cache_spec,
+                    jax.ShapeDtypeStruct((P, L), i32),
+                    jax.ShapeDtypeStruct((P,), i32),
+                    jax.ShapeDtypeStruct((P, mb), i32),
+                    jax.ShapeDtypeStruct((P,), i32),
+                    key_spec,
+                    jax.ShapeDtypeStruct((P,), jnp.float32),
+                    jax.ShapeDtypeStruct((P,), i32)).compile()
+        S = c.decode_slots
+        jitted = jax.jit(decode, donate_argnums=_donate_argnums())
+        self._compiled[("decode",)] = jitted.lower(
+            self.params, self.state, cache_spec,
+            jax.ShapeDtypeStruct((S,), i32),
+            jax.ShapeDtypeStruct((S,), i32),
+            jax.ShapeDtypeStruct((S, mb), i32),
+            jax.ShapeDtypeStruct((S,), jnp.bool_),
+            key_spec,
+            jax.ShapeDtypeStruct((S,), jnp.float32),
+            jax.ShapeDtypeStruct((S,), i32)).compile()
+        # one touch per executable: first real traffic must not pay
+        # dispatch-setup either
+        cache, key = self.make_cache(), self.fresh_key()
+        for P in c.prefill_batches:
+            for L in c.prompt_rungs:
+                _, cache, key = self.run_prefill(
+                    cache, np.zeros((P, L), np.int32),
+                    np.ones((P,), np.int32), np.zeros((P, mb), np.int32),
+                    np.full((P,), S, np.int32), key,
+                    np.zeros((P,), np.float32), np.zeros((P,), np.int32))
+        _, cache, key = self.run_decode(
+            cache, np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+            np.zeros((S, mb), np.int32), np.zeros((S,), np.bool_), key,
+            np.zeros((S,), np.float32), np.zeros((S,), np.int32))
+        return self
+
+    @property
+    def warmed(self) -> bool:
+        c = self.config
+        want = {("prefill", P, L) for P in c.prefill_batches
+                for L in c.prompt_rungs} | {("decode",)}
+        return want <= set(self._compiled)
+
+    # ---------------------------------------------------------------- running
+    def run_prefill(self, cache, tokens, lengths, tables, slots, key, temp,
+                    topk):
+        """Returns (first_tokens np [P], cache', key')."""
+        P, L = tokens.shape
+        exe = self._compiled.get(("prefill", P, L))
+        if exe is None:
+            from ..errors import ServingError
+            raise ServingError(
+                f"no warmed prefill program for (batch={P}, rung={L}) — "
+                f"call warm() before serving (warmed: "
+                f"{sorted(k for k in self._compiled if k[0] == 'prefill')})")
+        tok, cache, key = exe(self.params, self.state, cache, tokens,
+                              lengths, tables, slots, key, temp, topk)
+        return np.asarray(tok), cache, key
+
+    def run_decode(self, cache, tokens, pos, tables, active, key, temp,
+                   topk):
+        """Returns (next_tokens np [S], cache', key')."""
+        exe = self._compiled.get(("decode",))
+        if exe is None:
+            from ..errors import ServingError
+            raise ServingError("no warmed decode program — call warm() "
+                               "before serving")
+        tok, cache, key = exe(self.params, self.state, cache, tokens, pos,
+                              tables, active, key, temp, topk)
+        return np.asarray(tok), cache, key
+
+    # --------------------------------------------------------------- hot-swap
+    def with_params_from(self, net) -> "GenerationProgramSet":
+        """Same-architecture swap: new set sharing THIS set's executables.
+        Raises ValueError when the signature changed (caller warms a fresh
+        set before cutover)."""
+        new = GenerationProgramSet(net, config=self.config,
+                                   adapter=self.adapter,
+                                   trace_hook=self._trace_hook)
+        if new.signature != self.signature:
+            raise ValueError("parameter/architecture changed; full warm-up "
+                             "required")
+        new._compiled = self._compiled
+        return new

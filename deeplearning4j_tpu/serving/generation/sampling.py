@@ -1,0 +1,32 @@
+"""Token sampling, jit-carried PRNG: greedy / temperature / top-k.
+
+Runs INSIDE the compiled prefill/decode programs — per-request temperature
+and top-k are runtime arrays, so changing them never recompiles, and the
+PRNG key threads through the programs as a carried device array (split
+in-program; the host never touches randomness on the decode path).
+
+Greedy (temperature <= 0) is ``argmax`` over the model-dtype logits — the
+exact comparison the naive full-recompute reference makes, which is what
+lets the bit-exactness pin hold in bf16 as well as f32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits, key, temperature, top_k):
+    """logits [N,V] (pre-activation, model dtype); temperature [N] f32
+    (<=0 -> greedy); top_k [N] int32 (<=0 -> full vocab). Returns
+    (tokens [N] int32, new key)."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    key, sub = jax.random.split(key)
+    lf = logits.astype(jnp.float32)
+    scaled = lf / jnp.maximum(temperature, 1e-6)[:, None]
+    kk = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    thr = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+    sampled = jax.random.categorical(sub, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled), key

@@ -1,0 +1,488 @@
+"""Continuous batching: slot-based decode scheduling with step-boundary
+admission, per-token streams, and cohort-pinned hot-swap.
+
+One dispatch thread per model owns the decode loop:
+
+    loop:  admit (bucketed prefill for queued requests, into free slots)
+           -> one decode step per live cohort (ALL in-flight sequences
+              advance one token)
+           -> emit tokens to per-request TokenStreams, retire finished
+              slots (stop token / max_tokens / deadline / cancel), which
+              frees their cache blocks for the next admission
+
+Admission happens at step boundaries only — a new request never stalls
+in-flight decode, it just lands in the next step's batch (freed slots are
+backfilled from the queue; idle slots ride along masked). All device work
+goes through the cohort's AOT-warmed ``GenerationProgramSet``; the host
+side is numpy-only, so steady state never traces (a ``RecompileDetector``
+stays armed on the loop to prove it).
+
+Hot-swap cutover rule: a request is pinned to the program set (params) it
+was admitted under. After ``hot_swap``, new admissions form a NEW cohort on
+the new params (its own cache pool); old cohorts keep decoding on the old
+params until they drain, then their pool is dropped. During the transition
+each step runs one decode program per live cohort.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ...telemetry import RecompileDetector, span
+from ..errors import (BlockPoolExhaustedError, DeadlineExceededError,
+                      DrainingError, GenerationClosedError, QueueFullError,
+                      ShapeMismatchError)
+from .kvcache import BlockAllocator
+from .metrics import GenerationMetrics
+from .programs import GenerationProgramSet
+
+
+class TokenStream:
+    """Per-request token stream: the scheduler produces, ONE consumer
+    iterates (or calls ``result()`` — not both). Always terminates: every
+    admitted request is finished with a reason (or failed) exactly once,
+    so iterating callers can never hang."""
+
+    def __init__(self):
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._done = threading.Event()
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.emitted = 0
+        self._cancel_cb = None
+
+    # ---------------------------------------------------- producer (loop)
+    def _put(self, tok: int) -> None:
+        self.emitted += 1
+        self._q.put(("tok", tok))
+
+    def _finish(self, reason: str, error: Optional[BaseException] = None):
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self.error = error
+        self._done.set()
+        self._q.put(("end", reason))
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        while True:
+            kind, val = self._q.get()
+            if kind == "tok":
+                yield val
+            else:
+                return
+
+    def result(self, raise_on_error: bool = True):
+        """Drain the stream; returns (tokens, finish_reason). With
+        ``raise_on_error`` a stream that failed (engine error/shutdown)
+        raises instead of returning partial output."""
+        tokens = list(self)
+        if raise_on_error and self.error is not None \
+                and self.finish_reason not in ("deadline",):
+            raise self.error
+        return tokens, self.finish_reason
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> None:
+        """Consumer gave up (e.g. HTTP client disconnected): the scheduler
+        retires the slot at the next step boundary."""
+        if self._cancel_cb is not None:
+            self._cancel_cb()
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "stop",
+                 "deadline", "stream", "slot", "blocks", "emitted",
+                 "cancelled", "cancel_reason", "enqueue_t", "cohort")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
+                 top_k: int, stop: frozenset, deadline: float):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.stop = stop
+        self.deadline = deadline
+        self.stream = TokenStream()
+        self.stream._cancel_cb = self._cancel
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self.cohort = None                  # set at admission
+        self.emitted = 0
+        self.cancelled = False
+        self.cancel_reason = "cancelled"
+        self.enqueue_t = time.monotonic()
+
+    def _cancel(self):
+        self.cancelled = True
+
+
+class _Cohort:
+    """In-flight sequences pinned to one program set (one model version):
+    their cache pool, block allocator and block tables live and die with
+    the cohort."""
+    __slots__ = ("ps", "cache", "allocator", "tables", "slots", "version")
+
+    def __init__(self, ps: GenerationProgramSet, version: int):
+        self.ps = ps
+        self.version = version
+        self.cache = ps.make_cache()
+        self.allocator = BlockAllocator(ps.config.num_blocks)
+        S, mb = ps.config.decode_slots, ps.config.blocks_per_seq
+        self.tables = np.zeros((S, mb), np.int32)
+        self.slots: Set[int] = set()
+
+
+class ModelRuntime:
+    """Scheduler + device state for one generation model."""
+
+    def __init__(self, name: str, ps: GenerationProgramSet,
+                 metrics: Optional[GenerationMetrics] = None, *,
+                 watch_recompiles: bool = True):
+        self.name = name
+        self.active_ps = ps
+        self.version = 1
+        self.swap_lock = threading.Lock()
+        self.config = ps.config
+        self.metrics = metrics or GenerationMetrics(name=name)
+        S = self.config.decode_slots
+        self._queue: "deque[_GenRequest]" = deque()
+        self._cond = threading.Condition()
+        self._slots_free: Set[int] = set(range(S))
+        self._slot_req: Dict[int, _GenRequest] = {}
+        self._tokens = np.zeros(S, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._temp = np.zeros(S, np.float32)
+        self._topk = np.zeros(S, np.int32)
+        self._active = np.zeros(S, np.bool_)
+        self._cohorts: List[_Cohort] = []
+        self._key = ps.fresh_key()
+        self._draining = False
+        self._stopped = False
+        self._det = RecompileDetector(allowed=0, warn=False) \
+            if watch_recompiles else None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"generation-{name}")
+        self._thread.start()
+
+    # -------------------------------------------------------------- admission
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._slot_req)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def submit(self, prompt, *, max_new: int, temperature: float = 0.0,
+               top_k: int = 0, stop: Sequence[int] = (),
+               timeout: Optional[float] = None) -> TokenStream:
+        cfg = self.config
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        if plen < 1:
+            raise ShapeMismatchError("empty prompt")
+        if max_new < 1:
+            raise ShapeMismatchError(f"max_tokens must be >= 1, "
+                                     f"got {max_new}")
+        if plen > cfg.max_prompt_len:
+            raise ShapeMismatchError(
+                f"prompt length {plen} exceeds the largest warmed prompt "
+                f"rung {cfg.max_prompt_len}")
+        if plen + max_new > cfg.capacity:
+            raise ShapeMismatchError(
+                f"prompt ({plen}) + max_tokens ({max_new}) exceeds cache "
+                f"capacity {cfg.capacity} tokens")
+        if self.active_ps.adapter == "paged":
+            need = cfg.blocks_needed(plen, max_new)
+            if need > cfg.num_blocks - 1:
+                raise BlockPoolExhaustedError(
+                    f"request needs {need} cache blocks but the pool only "
+                    f"has {cfg.num_blocks - 1} — lower max_tokens or grow "
+                    f"num_blocks; retry will not help at this size",
+                    retryable=False)
+        timeout = cfg.default_timeout_s if timeout is None else timeout
+        req = _GenRequest(prompt, int(max_new), float(temperature),
+                          int(top_k), frozenset(int(s) for s in stop),
+                          time.monotonic() + timeout)
+        with self._cond:
+            if self._draining or self._stopped:
+                self.metrics.record_rejection("draining")
+                raise DrainingError(
+                    f"generation model '{self.name}' is draining/stopped")
+            if len(self._queue) >= self.config.queue_limit:
+                cohorts = self._cohorts       # loop thread rebinds the list
+                coh = cohorts[-1] if cohorts else None
+                if self.active_ps.adapter == "paged" and coh is not None \
+                        and coh.allocator.free_blocks == 0:
+                    self.metrics.record_rejection("exhausted")
+                    raise BlockPoolExhaustedError(
+                        f"model '{self.name}': KV block pool exhausted and "
+                        f"admission queue full ({self.config.queue_limit}) "
+                        f"— retry after in-flight generations complete")
+                self.metrics.record_rejection("full")
+                raise QueueFullError(
+                    f"model '{self.name}' generation queue full "
+                    f"({self.config.queue_limit} requests)")
+            self.metrics.record_request()
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req.stream
+
+    # ------------------------------------------------------------ loop body
+    def _loop(self):
+        if self._det is not None:
+            self._det.__enter__()
+        try:
+            while True:
+                with self._cond:
+                    if self._stopped:
+                        break
+                    if not self._queue and not self._slot_req:
+                        self._cond.wait(0.02)
+                        continue
+                try:
+                    self._admit()
+                    self._step()
+                except Exception as e:       # defensive: nobody may hang
+                    self._fail_all(e)
+        finally:
+            if self._det is not None:
+                self._det.__exit__(None, None, None)
+            self._shutdown_flush()
+
+    def _cohort_for_admission(self) -> _Cohort:
+        ps = self.active_ps
+        if self._cohorts and self._cohorts[-1].ps is ps:
+            return self._cohorts[-1]
+        coh = _Cohort(ps, self.version)
+        self._cohorts.append(coh)
+        return coh
+
+    def _admit(self):
+        cfg = self.config
+        cands: List[_GenRequest] = []
+        now = time.monotonic()
+        with self._cond:
+            # expire/cancel while queued
+            q = self._queue
+            keep: "deque[_GenRequest]" = deque()
+            while q:
+                r = q.popleft()
+                if r.cancelled:
+                    r.stream._finish(r.cancel_reason)
+                    self.metrics.record_finish(r.cancel_reason)
+                elif now > r.deadline:
+                    self.metrics.record_rejection("deadline")
+                    r.stream._finish("deadline", DeadlineExceededError(
+                        "deadline expired while queued for admission"))
+                else:
+                    keep.append(r)
+            self._queue = keep
+            if not self._queue or not self._slots_free:
+                return
+            coh = self._cohort_for_admission()
+            max_p = cfg.prefill_batches[-1]
+            while self._queue and self._slots_free and len(cands) < max_p:
+                r = self._queue[0]
+                need = 0 if coh.ps.adapter == "state" else \
+                    cfg.blocks_needed(len(r.prompt), r.max_new)
+                if need > coh.allocator.free_blocks:
+                    break            # head-of-line: wait for blocks to free
+                self._queue.popleft()
+                r.blocks = coh.allocator.alloc(need) if need else []
+                r.slot = self._slots_free.pop()
+                r.cohort = coh
+                self._slot_req[r.slot] = r
+                cands.append(r)
+        if not cands:
+            return
+        S, mb = cfg.decode_slots, cfg.blocks_per_seq
+        P = cfg.prefill_rung(len(cands))
+        L = cfg.prompt_rung(max(len(r.prompt) for r in cands))
+        tokens = np.zeros((P, L), np.int32)
+        lengths = np.ones(P, np.int32)
+        tables_p = np.zeros((P, mb), np.int32)
+        slots = np.full(P, S, np.int32)          # padding rows -> trash slot
+        temp = np.zeros(P, np.float32)
+        topk = np.zeros(P, np.int32)
+        for i, r in enumerate(cands):
+            plen = len(r.prompt)
+            tokens[i, :plen] = r.prompt
+            lengths[i] = plen
+            tables_p[i, :len(r.blocks)] = r.blocks
+            slots[i] = r.slot
+            temp[i] = r.temperature
+            topk[i] = r.top_k
+        with span("generation.prefill", model=self.name, batch=len(cands),
+                  rung=L):
+            first, coh.cache, self._key = coh.ps.run_prefill(
+                coh.cache, tokens, lengths, tables_p, slots, self._key,
+                temp, topk)
+        now = time.monotonic()
+        emitted = 0
+        for i, r in enumerate(cands):
+            s = r.slot
+            coh.slots.add(s)
+            coh.tables[s] = tables_p[i]
+            self._pos[s] = len(r.prompt)
+            self._temp[s] = r.temperature
+            self._topk[s] = r.top_k
+            did_emit, _ = self._slot_emit(coh, r, int(first[i]), now)
+            emitted += did_emit
+        self.metrics.record_prefill(
+            len(cands), [(now - r.enqueue_t) * 1e3 for r in cands],
+            emitted)
+
+    def _step(self):
+        cfg = self.config
+        S = cfg.decode_slots
+        for coh in list(self._cohorts):
+            live = [s for s in sorted(coh.slots) if self._active[s]]
+            if not live:
+                continue
+            mask = np.zeros(S, np.bool_)
+            mask[live] = True
+            t0 = time.perf_counter()
+            with span("generation.decode_step", model=self.name,
+                      slots=len(live)):
+                nxt, coh.cache, self._key = coh.ps.run_decode(
+                    coh.cache, self._tokens, self._pos, coh.tables, mask,
+                    self._key, self._temp, self._topk)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            now = time.monotonic()
+            emitted = 0
+            for s in live:
+                r = self._slot_req[s]
+                did_emit, cont = self._slot_emit(coh, r, int(nxt[s]), now)
+                emitted += did_emit
+                if cont:
+                    self._pos[s] += 1
+            self.metrics.record_decode_step(
+                dt_ms, len(live), emitted, slots=S,
+                blocks_used=coh.allocator.used_blocks,
+                blocks_total=coh.allocator.total_usable,
+                queue_depth=len(self._queue))
+        if self._det is not None:
+            self.metrics.record_recompile(self._det.count)
+        # drop drained cohorts (old params/pools released)
+        self._cohorts = [c for c in self._cohorts
+                         if c.slots or c.ps is self.active_ps]
+
+    def _slot_emit(self, coh: _Cohort, r: _GenRequest, tok: int,
+                   now: float):
+        """Handle one sampled token for a slot: emit/terminate. Returns
+        (emitted, continuing)."""
+        if r.cancelled:
+            # a shutdown-cancel must surface as an ERROR to blocking
+            # callers (engine stopped under them); a consumer cancel is a
+            # normal close
+            err = GenerationClosedError("engine stopped mid-generation") \
+                if r.cancel_reason == "shutdown" else None
+            return self._finish_slot(coh, r, r.cancel_reason, err)
+        if now > r.deadline:
+            return self._finish_slot(
+                coh, r, "deadline",
+                DeadlineExceededError("deadline expired mid-generation "
+                                      f"after {r.emitted} tokens"))
+        if tok in r.stop:
+            return self._finish_slot(coh, r, "stop")
+        r.stream._put(tok)
+        r.emitted += 1
+        if r.emitted >= r.max_new:
+            out = self._finish_slot(coh, r, "length")
+            return (1, out[1])
+        self._tokens[r.slot] = tok
+        self._active[r.slot] = True
+        return (1, True)
+
+    def _finish_slot(self, coh: _Cohort, r: _GenRequest, reason: str,
+                     error: Optional[BaseException] = None):
+        s = r.slot
+        r.stream._finish(reason, error)
+        self.metrics.record_finish(reason)
+        if r.blocks:
+            coh.allocator.free(r.blocks)
+            r.blocks = []
+        coh.slots.discard(s)
+        self._active[s] = False
+        with self._cond:
+            del self._slot_req[s]
+            self._slots_free.add(s)
+            self._cond.notify_all()
+        return (0, False)
+
+    def _fail_all(self, exc: BaseException):
+        """A dispatch-side failure must resolve every caller (the batcher
+        contract): fail queued + in-flight, release blocks/slots.
+        Iterates ``_slot_req`` (not cohort slot sets) so requests whose
+        PREFILL raised — admitted but never added to a cohort's slots —
+        are failed too instead of hanging their callers. Every cohort is
+        dropped: after a program failure its cache may reference donated
+        (invalidated) buffers, so the next admission must build a fresh
+        pool."""
+        self.metrics.record_rejection("error")
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+            reqs = list(self._slot_req.values())
+        for r in queued:
+            r.stream._finish("error", exc)
+        for r in reqs:
+            self._finish_slot(r.cohort, r, "error", exc)
+        self._cohorts = []
+
+    def _shutdown_flush(self):
+        err = DrainingError(f"generation model '{self.name}' stopped")
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+            reqs = list(self._slot_req.values())
+        for r in queued:
+            r.stream._finish("shutdown", err)
+            self.metrics.record_finish("shutdown")
+        for r in reqs:
+            self._finish_slot(r.cohort, r, "shutdown",
+                              GenerationClosedError(
+                                  "engine stopped mid-generation"))
+        self._cohorts = []
+
+    # ------------------------------------------------------------- lifecycle
+    def stop(self, drain: bool = True, timeout: float = 10.0):
+        """drain=True: refuse new work (503) but let queued + in-flight
+        generations COMPLETE (bounded by ``timeout``); drain=False: refuse
+        new work and terminate everything now. Either way every stream is
+        finished — no caller is left hanging."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                for r in list(self._queue):
+                    r.stream._finish("shutdown", DrainingError(
+                        f"model '{self.name}' shut down before admission"))
+                self._queue.clear()
+                for r in self._slot_req.values():
+                    r.cancelled = True
+                    r.cancel_reason = "shutdown"
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and \
+                (self._queue or self._slot_req):
+            time.sleep(0.005)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        self._shutdown_flush()    # belt-and-braces if the thread wedged

@@ -1,0 +1,98 @@
+"""Autoregressive generation serving: paged KV-cache decode, continuous
+batching, per-token HTTP streaming.
+
+Walks the full subsystem end to end on CPU:
+  1. build + (toy-)init a transformer LM and warm a GenerationEngine —
+     every prefill rung and the decode-step program AOT-compiled up front;
+  2. blocking and streaming generation, greedy vs temperature/top-k;
+  3. concurrent clients sharing the in-flight decode batch (continuous
+     batching) with ZERO steady-state XLA compiles, proven by the
+     process-wide compile counter;
+  4. per-token streaming over HTTP (POST /generate, chunked NDJSON);
+  5. zero-downtime hot-swap mid-decode: the in-flight stream finishes on
+     the old params, the next request runs the new ones;
+  6. the generation metrics snapshot (TTFT, tokens/sec, slot occupancy).
+
+Run: python examples/serving_generate.py
+"""
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+from deeplearning4j_tpu.serving import (GenerationEngine, ServingHTTPServer,
+                                        xla_compile_count)
+
+VOCAB = 101
+
+print("== 1. build + warm (all generation programs AOT-compiled) ==")
+net = transformer_lm(vocab_size=VOCAB, d_model=64, n_heads=2, n_blocks=2,
+                     max_length=128, seed=7, token_input=True).init()
+eng = GenerationEngine(net, model_name="lm", block_len=16, max_seq_len=128,
+                       decode_slots=8, prefill_batches=(1, 2, 4),
+                       prompt_rungs=(32, 128))
+print(f"warmed: {eng.models()['lm']}")
+
+print("\n== 2. blocking + streaming, greedy vs sampled ==")
+rng = np.random.default_rng(3)
+prompt = rng.integers(1, VOCAB, size=12).tolist()
+tokens, reason = eng.generate(prompt, max_tokens=24)
+print(f"greedy ({reason}): {tokens}")
+stream = eng.generate(prompt, max_tokens=24, temperature=0.8, top_k=40,
+                      stream=True)
+sampled = list(stream)          # arrives token by token
+print(f"sampled ({stream.finish_reason}): {sampled}")
+
+print("\n== 3. continuous batching: 12 clients, 8 slots, 0 compiles ==")
+c0 = xla_compile_count()
+done = []
+
+def client(i):
+    p = rng.integers(1, VOCAB, size=int(rng.integers(2, 30))).tolist()
+    toks, why = eng.generate(p, max_tokens=int(rng.integers(4, 32)))
+    done.append((i, len(toks), why))
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print(f"completed {len(done)} generations, "
+      f"steady-state compiles: {xla_compile_count() - c0}")
+
+print("\n== 4. per-token streaming over HTTP ==")
+srv = ServingHTTPServer(generation=eng)
+base = f"http://127.0.0.1:{srv.start()}"
+req = urllib.request.Request(
+    base + "/generate",
+    json.dumps({"prompt": prompt, "max_tokens": 8}).encode(),
+    {"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=30) as r:
+    for line in r:
+        print("  chunk:", line.decode().strip())
+
+print("\n== 5. hot-swap mid-decode: in-flight finishes on OLD params ==")
+net2 = transformer_lm(vocab_size=VOCAB, d_model=64, n_heads=2, n_blocks=2,
+                      max_length=128, seed=8, token_input=True).init()
+long_stream = eng.generate(prompt, max_tokens=60, stream=True)
+version = eng.hot_swap("lm", net2)          # same arch: executables reused
+after = eng.generate(prompt, max_tokens=8)[0]
+old_out = list(long_stream)
+print(f"swap -> version {version}; in-flight emitted {len(old_out)} tokens "
+      f"on old params; post-swap output (new params): {after}")
+
+print("\n== 6. metrics ==")
+snap = eng.metrics()["lm"]
+for k in ("requests", "tokens_out", "prefills", "decode_steps", "ttft_ms",
+          "decode_step_ms", "slot_occupancy", "tokens_per_sec_recent",
+          "finished", "decode_recompiles"):
+    print(f"  {k}: {snap[k]}")
+
+srv.stop()
+print("\ndone.")

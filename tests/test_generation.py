@@ -1,0 +1,563 @@
+"""serving/generation: paged KV-cache decode with continuous batching.
+
+Pins (ISSUE 9):
+  - bit-exactness: greedy decode through the paged-cache path matches
+    naive full-recompute decode token-for-token (f32 AND bf16, token-id
+    and one-hot embed inputs) — same pinning pattern as
+    tests/test_overlap_sync.py;
+  - zero recompiles: after warm-up, a mixed stream of prompt lengths and
+    generation lengths triggers ZERO backend compiles (asserted via the
+    telemetry RecompileDetector, as test_zero_recompiles_after_warmup
+    does for forward serving);
+  - continuous batching: requests admitted into an in-flight decode batch
+    at step boundaries produce the same tokens as isolated decodes;
+  - admission-control/deadline/drain semantics carried over from
+    serving/engine.py, plus the block-pool exhaustion taxonomy;
+  - hot-swap cutover rule: in-flight generations finish on old params,
+    new admissions run the new model.
+
+Heavy soak variants are marked ``slow``; tier-1 keeps the same assertions
+at a handful-of-requests scale.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.decode import (LSTMDecodeSpec,
+                                              TransformerDecodeSpec,
+                                              naive_generate,
+                                              naive_generate_lstm)
+from deeplearning4j_tpu.models.zoo_extra import (text_generation_lstm,
+                                                 transformer_lm)
+from deeplearning4j_tpu.serving import (BlockPoolExhaustedError,
+                                        DrainingError, GenerationConfig,
+                                        GenerationEngine, QueueFullError,
+                                        ShapeMismatchError,
+                                        xla_compile_count)
+from deeplearning4j_tpu.serving.generation import BlockAllocator
+from deeplearning4j_tpu.telemetry import RecompileDetector, get_registry
+
+R = np.random.default_rng(99)
+
+
+def _lm(seed=7, vocab=53, d_model=32, n_heads=2, n_blocks=2, max_length=64,
+        dtype="float32", token_input=True):
+    return transformer_lm(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_blocks=n_blocks,
+                          max_length=max_length, seed=seed, dtype=dtype,
+                          token_input=token_input).init()
+
+
+def _prompts(vocab, sizes, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, size=n).tolist() for n in sizes]
+
+
+# ------------------------------------------------------- pool + config units
+def test_block_allocator():
+    a = BlockAllocator(5)              # ids 1..4 usable, 0 is trash
+    assert a.total_usable == 4 and a.free_blocks == 4
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.free_blocks == 1 and a.used_blocks == 3
+    with pytest.raises(BlockPoolExhaustedError):
+        a.alloc(2)
+    a.free(got[:2])
+    assert a.free_blocks == 3
+    with pytest.raises(ValueError):
+        a.free([got[0]])               # double free
+    with pytest.raises(ValueError):
+        a.free([0])                    # trash block is not freeable
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+
+
+def test_generation_config_plan():
+    cfg = GenerationConfig(block_len=16, max_seq_len=100, decode_slots=4,
+                           prompt_rungs=(20, 50), prefill_batches=(4, 1, 1))
+    assert cfg.capacity == 112                 # rounded up to block_len
+    assert cfg.blocks_per_seq == 7
+    # rungs round up to block multiples and always include the capacity
+    assert cfg.prompt_rungs == (32, 64, 112)
+    assert cfg.prefill_batches == (1, 4)
+    assert cfg.blocks_needed(10, 6) == 1
+    assert cfg.blocks_needed(10, 7) == 2
+    assert cfg.prompt_rung(33) == 64
+    assert cfg.prefill_rung(3) == 4
+    assert cfg.num_blocks == 4 * 7 + 1
+    with pytest.raises(ValueError):
+        cfg.prompt_rung(113)
+
+
+# ------------------------------------------- shared read-only engine + pins
+@pytest.fixture(scope="module")
+def shared_lm():
+    """One warmed f32 engine shared by the read-only tests below (every
+    AOT warm-up is seconds of tier-1 budget). Tests using it must leave it
+    healthy: no stop(), no monkeypatching, no pool reconfiguration."""
+    net = _lm(dtype="float32")
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=64,
+                           decode_slots=4, prefill_batches=(1, 2),
+                           prompt_rungs=(64,))
+    yield net, TransformerDecodeSpec(net), eng
+    eng.stop()
+
+
+def test_paged_greedy_bit_identical_to_naive_f32(shared_lm):
+    """THE pin: greedy decode through the paged KV cache — sequential AND
+    continuous-batched concurrent — matches cache-free full-recompute
+    decode token-for-token."""
+    net, spec, eng = shared_lm
+    prompts = _prompts(53, (5, 9, 13))
+    refs = [naive_generate(net, p, 10, pad_to=64, spec=spec)
+            for p in prompts]
+    req0 = eng.metrics()["lm"]["requests"]
+    for p, want in zip(prompts, refs):
+        toks, reason = eng.generate(p, max_tokens=10)
+        assert reason == "length"
+        assert toks == want
+    # continuous batching: 6 concurrent clients share 4 decode slots —
+    # step-boundary admission + slot backfill must not perturb numerics
+    outs = {}
+
+    def client(i):
+        st = eng.generate(prompts[i % 3], max_tokens=10, stream=True)
+        outs[i] = (list(st), st.finish_reason)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(6):
+        assert outs[i][0] == refs[i % 3], f"client {i} diverged"
+        assert outs[i][1] == "length"
+    snap = eng.metrics()["lm"]
+    assert snap["requests"] == req0 + 9
+    assert snap["finished"].get("length", 0) >= 9
+
+
+@pytest.mark.parametrize("dtype,token_input", [("bfloat16", True),
+                                               ("float32", False)])
+def test_paged_greedy_bit_identical_dtypes_and_embeds(dtype, token_input):
+    """Same pin in bf16 and through the legacy one-hot embed input."""
+    net = _lm(seed=11, vocab=37, d_model=16, n_blocks=1, max_length=32,
+              dtype=dtype, token_input=token_input)
+    spec = TransformerDecodeSpec(net)
+    prompts = _prompts(37, (4, 7), seed=5)
+    refs = [naive_generate(net, p, 8, pad_to=32, spec=spec)
+            for p in prompts]
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=32,
+                           decode_slots=2, prefill_batches=(1,),
+                           prompt_rungs=(32,))
+    try:
+        for p, want in zip(prompts, refs):
+            toks, _ = eng.generate(p, max_tokens=8)
+            assert toks == want
+    finally:
+        eng.stop()
+
+
+def test_lstm_generation_matches_rnn_time_step():
+    """The recurrent leg: engine decode (fixed-shape state cache) matches
+    the public rnn_time_step greedy loop token-for-token."""
+    net = text_generation_lstm(vocab_size=31, hidden=24, max_length=32,
+                               seed=5).init()
+    assert LSTMDecodeSpec(net).vocab == 31
+    prompts = _prompts(31, (3, 7), seed=11)
+    refs = [naive_generate_lstm(net, p, 8) for p in prompts]
+    eng = GenerationEngine(net, model_name="charlm", block_len=8,
+                           max_seq_len=32, decode_slots=2,
+                           prefill_batches=(1, 2), prompt_rungs=(16,))
+    try:
+        assert eng.models()["charlm"]["adapter"] == "state"
+        outs = {}
+
+        def client(i):
+            outs[i] = eng.generate(prompts[i % 2], max_tokens=8)[0]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(4):
+            assert outs[i] == refs[i % 2]
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------------- zero recompiles
+@pytest.mark.bench_smoke
+def test_zero_recompiles_generation_after_warmup():
+    """Tier-1 guard (ISSUE acceptance): after warm-up, a mixed stream of
+    prompt lengths (two rungs), generation lengths, sampling settings and
+    concurrent admissions triggers ZERO backend compiles — asserted via
+    the telemetry RecompileDetector AND the process-wide compile counter
+    AND the engine's own trace hook."""
+    net = _lm(seed=21, vocab=41, d_model=16, n_blocks=1, max_length=64)
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=64,
+                           decode_slots=4, prefill_batches=(1, 2),
+                           prompt_rungs=(16, 64), seed=3)
+    try:
+        traces0 = eng.trace_count
+        compiles0 = xla_compile_count()
+        work = [(3, 5, 0.0, 0), (14, 9, 0.0, 0), (30, 4, 0.7, 5),
+                (7, 12, 1.2, 0), (40, 3, 0.0, 2), (2, 17, 0.3, 3)]
+        results = {}
+
+        def client(i):
+            plen, mx, temp, topk = work[i]
+            p = [(i * 7 + j) % 40 + 1 for j in range(plen)]
+            st = eng.generate(p, max_tokens=mx, temperature=temp,
+                              top_k=topk, stream=True)
+            results[i] = (list(st), st.finish_reason)
+
+        with RecompileDetector(allowed=0) as det:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(work))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for i, (plen, mx, _, _) in enumerate(work):
+            assert len(results[i][0]) == mx
+            assert results[i][1] == "length"
+            assert all(0 <= t < 41 for t in results[i][0])
+        assert det.count == 0, \
+            f"steady-state decode compiled: {det.events}"
+        assert xla_compile_count() == compiles0
+        assert eng.trace_count == traces0, "generation re-traced a program"
+        # telemetry mirror: the decode loop published its gauges/counters
+        reg = get_registry()
+        snap = reg.snapshot()
+        assert snap["counters"].get("generation.lm.tokens_out", 0) >= 50
+        assert "generation.lm.slot_occupancy" in snap["gauges"]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------- sampling
+def test_sampling_modes_and_stop_tokens(shared_lm):
+    net, spec, eng = shared_lm
+    prompt = [3, 9, 4]
+    greedy = naive_generate(net, prompt, 6, pad_to=64, spec=spec)
+    # top_k=1 collapses sampling to greedy at ANY temperature
+    toks, _ = eng.generate(prompt, max_tokens=6, temperature=5.0,
+                           top_k=1)
+    assert toks == greedy
+    # temperature sampling emits valid ids and the full budget
+    toks, reason = eng.generate(prompt, max_tokens=12, temperature=1.0,
+                                top_k=4)
+    assert reason == "length" and len(toks) == 12
+    assert all(0 <= t < 53 for t in toks)
+    # stop tokens terminate with reason "stop" and are NOT emitted
+    stop = greedy[3]
+    toks, reason = eng.generate(prompt, max_tokens=6, stop=[stop])
+    assert reason == "stop"
+    assert toks == greedy[:greedy.index(stop)]
+    assert eng.metrics()["lm"]["finished"].get("stop", 0) >= 1
+
+
+# ---------------------------------------------- admission control + errors
+def test_block_pool_exhaustion_and_queue_taxonomy():
+    """Tiny pool: one request's blocks occupy it entirely. The queue
+    head-of-line waits for blocks; an over-limit submit while the pool is
+    dry raises BlockPoolExhaustedError (429 + retry hint), and a request
+    that can NEVER fit fails immediately."""
+    net = _lm(seed=41, vocab=29, d_model=16, n_blocks=1, max_length=32)
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=32,
+                           decode_slots=2, prefill_batches=(1,),
+                           prompt_rungs=(32,), num_blocks=3, queue_limit=1)
+    try:
+        # within capacity but needs more blocks than the pool HAS: a retry
+        # can never help -> immediate 429-with-hint
+        with pytest.raises(BlockPoolExhaustedError) as ei:
+            eng.generate([1, 2], max_tokens=28)     # 4 blocks, pool has 2
+        assert "retry" in str(ei.value)
+        # slow decode down so r1 deterministically holds its blocks for
+        # the whole submit sequence below (un-slowed it finishes in ms)
+        rt = eng._get("lm")
+        orig_decode = rt.active_ps.run_decode
+
+        def slow_decode(*a, **k):
+            time.sleep(0.01)
+            return orig_decode(*a, **k)
+
+        rt.active_ps.run_decode = slow_decode
+        # r1 takes both usable blocks (plen 2 + 14 new = 16 = 2 blocks)
+        s1 = eng.generate([1, 2], max_tokens=14, stream=True)
+        # wait until r1 is admitted (blocks held) before probing the queue
+        deadline = time.monotonic() + 5.0
+        while eng.metrics()["lm"]["prefills"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        s2 = eng.generate([3, 4], max_tokens=14, stream=True)   # queued
+        with pytest.raises(QueueFullError):          # queue_limit=1, dry pool
+            eng.generate([5, 6], max_tokens=14)
+        assert eng.metrics()["lm"]["rejected"]["exhausted"] >= 1
+        # head-of-line admission once r1's blocks free: both complete
+        t1, r1 = s1.result()
+        t2, r2 = s2.result()
+        assert (len(t1), r1) == (14, "length")
+        assert (len(t2), r2) == (14, "length")
+    finally:
+        eng.stop()
+
+
+def test_shape_validation(shared_lm):
+    _, _, eng = shared_lm                    # capacity 64, prompt rung 64
+    with pytest.raises(ShapeMismatchError):
+        eng.generate([], max_tokens=4)                  # empty prompt
+    with pytest.raises(ShapeMismatchError):
+        eng.generate([1] * 65, max_tokens=4)    # > largest prompt rung
+    with pytest.raises(ShapeMismatchError):
+        eng.generate([1, 2], max_tokens=63)             # > capacity
+    with pytest.raises(ShapeMismatchError):
+        eng.generate([1, 2], max_tokens=0)
+
+
+def test_deadline_mid_stream_terminates_cleanly(shared_lm):
+    """A deadline expiring mid-generation closes the stream with reason
+    'deadline' — the consumer's iteration ENDS (no hang), partial tokens
+    stand, and the slot/blocks are released for the next request."""
+    net, spec, eng = shared_lm
+    st = eng.generate([1, 2, 3], max_tokens=60, timeout=0.02,
+                      stream=True)
+    toks = list(st)                      # must terminate on its own
+    assert st.finish_reason == "deadline"
+    assert len(toks) < 60
+    assert st.emitted == len(toks)
+    # the slot is free again: a normal request completes afterwards
+    toks2, reason = eng.generate([4, 5], max_tokens=3)
+    assert (len(toks2), reason) == (3, "length")
+    assert eng.metrics()["lm"]["finished"].get("deadline", 0) >= 1
+
+
+def test_drain_and_stop_semantics():
+    """drain=True completes in-flight + queued work then refuses new
+    submissions (503); drain=False terminates everything NOW — either way
+    every stream finishes and no caller hangs."""
+    net = _lm(seed=53, vocab=29, d_model=16, n_blocks=1, max_length=64)
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=64,
+                           decode_slots=1, prefill_batches=(1,),
+                           prompt_rungs=(64,))
+    st = eng.generate([1, 2], max_tokens=20, stream=True)
+    eng.stop(drain=True, timeout=30.0)
+    toks, reason = st.result()
+    assert (len(toks), reason) == (20, "length")    # drained to completion
+    with pytest.raises(DrainingError):
+        eng.generate([1], max_tokens=1)
+
+    eng2 = GenerationEngine(net, model_name="lm", block_len=8,
+                            max_seq_len=64, decode_slots=1,
+                            prefill_batches=(1,), prompt_rungs=(64,))
+    st2 = eng2.generate([1, 2], max_tokens=60, stream=True)
+    time.sleep(0.01)                       # let it get in flight
+    eng2.stop(drain=False, timeout=5.0)
+    toks2 = list(st2)                      # terminates, partial or empty
+    assert st2.finish_reason == "shutdown"
+    assert len(toks2) < 60
+
+
+def test_prefill_failure_fails_caller_and_engine_recovers():
+    """A device-side program failure must resolve EVERY caller (no hung
+    streams), release the failed requests' slots and blocks, and drop the
+    cohort (its donated cache may be invalid) so the next admission runs
+    on a fresh pool — regression for the admitted-but-not-yet-in-cohort
+    window where a prefill exception previously leaked the slot and left
+    the stream waiting forever."""
+    net = _lm(seed=67, vocab=29, d_model=16, n_blocks=1, max_length=32)
+    spec = TransformerDecodeSpec(net)
+    want = naive_generate(net, [1, 2, 3], 4, pad_to=32, spec=spec)
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=32,
+                           decode_slots=2, prefill_batches=(1,),
+                           prompt_rungs=(32,))
+    try:
+        rt = eng._get("lm")
+        orig = rt.active_ps.run_prefill
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected device failure")
+            return orig(*a, **k)
+
+        rt.active_ps.run_prefill = boom
+        st = eng.generate([1, 2, 3], max_tokens=4, stream=True)
+        toks, reason = st.result(raise_on_error=False)   # must NOT hang
+        assert reason == "error"
+        assert isinstance(st.error, RuntimeError)
+        assert toks == []
+        # slot + blocks released, cohort rebuilt: next request is correct
+        toks2, r2 = eng.generate([1, 2, 3], max_tokens=4)
+        assert (toks2, r2) == (want, "length")
+        assert eng.models()["lm"]["in_flight"] == 0
+        snap = eng.metrics()["lm"]
+        assert snap["rejected"]["error"] >= 1
+        assert snap["finished"].get("error") == 1
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------- hot-swap
+def test_hot_swap_cutover_in_flight_on_old_params():
+    """The cutover rule: a generation in flight at swap time finishes on
+    the OLD params; the next admission runs the new ones. Same-arch swap
+    reuses compiled executables (no new traces/compiles)."""
+    net_a = _lm(seed=7)
+    net_b = _lm(seed=8)            # same arch, different params
+    spec_a, spec_b = TransformerDecodeSpec(net_a), TransformerDecodeSpec(net_b)
+    prompt = _prompts(53, (6,), seed=9)[0]
+    want_a = naive_generate(net_a, prompt, 40, pad_to=64, spec=spec_a)
+    want_b = naive_generate(net_b, prompt, 40, pad_to=64, spec=spec_b)
+    assert want_a != want_b        # the pin below must be discriminating
+    eng = GenerationEngine(net_a, model_name="lm", block_len=8,
+                           max_seq_len=64, decode_slots=2,
+                           prefill_batches=(1,), prompt_rungs=(64,))
+    try:
+        traces0 = eng.trace_count
+        compiles0 = xla_compile_count()
+        st_a = eng.generate(prompt, max_tokens=40, stream=True)
+        deadline = time.monotonic() + 5.0        # wait for admission
+        while eng.metrics()["lm"]["prefills"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        version = eng.hot_swap("lm", net_b)
+        assert version == 2
+        st_b = eng.generate(prompt, max_tokens=40, stream=True)
+        toks_a, reason_a = st_a.result()
+        toks_b, reason_b = st_b.result()
+        assert (toks_a, reason_a) == (want_a, "length"), \
+            "in-flight generation must finish on the OLD params"
+        assert (toks_b, reason_b) == (want_b, "length"), \
+            "post-swap admission must run the NEW params"
+        assert eng.trace_count == traces0          # executables reused
+        assert xla_compile_count() == compiles0
+        assert eng.metrics()["lm"]["hot_swaps"] == 1
+    finally:
+        eng.stop()
+
+
+def _swap_soak(n_swaps: int, clients: int, max_new: int):
+    net_a = _lm(seed=7)
+    net_b = _lm(seed=8)
+    spec_a, spec_b = TransformerDecodeSpec(net_a), TransformerDecodeSpec(net_b)
+    prompts = _prompts(53, (5, 9), seed=13)
+    want = {}
+    for i, p in enumerate(prompts):
+        want[i] = (naive_generate(net_a, p, max_new, pad_to=64, spec=spec_a),
+                   naive_generate(net_b, p, max_new, pad_to=64, spec=spec_b))
+    eng = GenerationEngine(net_a, model_name="lm", block_len=8,
+                           max_seq_len=64, decode_slots=4,
+                           prefill_batches=(1, 2), prompt_rungs=(64,))
+    errors = []
+    stop_flag = threading.Event()
+
+    def client(tid):
+        k = tid
+        while not stop_flag.is_set():
+            i = k % 2
+            toks, reason = eng.generate(prompts[i], max_tokens=max_new)
+            if reason != "length" or \
+                    (toks != want[i][0] and toks != want[i][1]):
+                errors.append((tid, k, reason, toks))
+                return
+            k += 1
+
+    try:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        nets = [net_b, net_a]
+        for s in range(n_swaps):
+            time.sleep(0.05)
+            eng.hot_swap("lm", nets[s % 2])
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors, f"hot-swap soak diverged: {errors[:3]}"
+        assert eng.metrics()["lm"]["hot_swaps"] == n_swaps
+    finally:
+        stop_flag.set()
+        eng.stop()
+
+
+def test_hot_swap_under_decode_soak_fast():
+    """Tier-1 fast variant of the hot-swap-under-decode soak: swaps land
+    while clients stream; every result must match ONE of the two param
+    sets exactly — never a mixture."""
+    _swap_soak(n_swaps=3, clients=3, max_new=12)
+
+
+@pytest.mark.slow
+def test_hot_swap_under_decode_soak():
+    _swap_soak(n_swaps=20, clients=6, max_new=24)
+
+
+# ------------------------------------------------------------------- bench
+@pytest.mark.bench_smoke
+def test_generate_bench_smoke():
+    """Tier-1 guard for the generate_tokens_per_sec row: both modes run end
+    to end, emit tokens, and stay at zero steady-state compiles. The >=3x
+    continuous-vs-sequential acceptance ratio is measured by bench.py on
+    the real rig at full duration; CI pins 'not broken'."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    row = bench.bench_generate(duration=0.8, clients=3, decode_slots=4,
+                               max_new=8, prompt_len=4)
+    assert row["continuous_tokens_per_sec"] > 0
+    assert row["sequential_tokens_per_sec"] > 0
+    assert row["continuous_steady_state_compiles"] == 0
+    assert row["sequential_steady_state_compiles"] == 0
+    assert row["continuous_ttft_p50_ms"] > 0
+
+
+@pytest.mark.slow
+def test_generation_hammer_soak():
+    """Sustained mixed traffic: many clients, mixed prompt rungs and
+    sampling settings, full-length streams — result integrity + zero
+    recompiles over thousands of tokens."""
+    net = _lm(seed=61, vocab=41, d_model=16, n_blocks=1, max_length=64)
+    eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=64,
+                           decode_slots=8, prefill_batches=(1, 2, 4),
+                           prompt_rungs=(16, 64), queue_limit=4096)
+    try:
+        compiles0 = xla_compile_count()
+        stop_at = time.monotonic() + 8.0
+        errors = []
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            while time.monotonic() < stop_at:
+                plen = int(rng.integers(1, 40))
+                mx = int(rng.integers(1, 20))
+                temp = float(rng.choice([0.0, 0.8]))
+                toks, reason = eng.generate(
+                    rng.integers(1, 41, size=plen).tolist(),
+                    max_tokens=mx, temperature=temp, timeout=60.0)
+                if reason != "length" or len(toks) != mx or \
+                        not all(0 <= t < 41 for t in toks):
+                    errors.append((tid, plen, mx, reason, len(toks)))
+                    return
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert xla_compile_count() == compiles0
+        assert eng.metrics()["lm"]["tokens_out"] > 500
+    finally:
+        eng.stop()

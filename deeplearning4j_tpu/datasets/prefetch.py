@@ -446,6 +446,20 @@ def _window_stackable(group) -> bool:
     return True
 
 
+def skip_batches(iterable, n: int):
+    """Consume (without yielding) the first ``n`` batches and return an
+    iterator over the rest — the mid-epoch-resume primitive shared by
+    ``Solver._fit_epoch`` and ``ParallelWrapper._fit_epoch`` (the
+    ElasticTrainer's bit-identical resume depends on both paths skipping
+    identically). Tolerates streams shorter than ``n``."""
+    src = iter(iterable)
+    _miss = object()
+    for _ in range(max(0, n)):
+        if next(src, _miss) is _miss:
+            break
+    return src
+
+
 def iter_windows(iterable, k: int):
     """Group a batch stream into ``BatchWindow``s of ``k``.
 

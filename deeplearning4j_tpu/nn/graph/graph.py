@@ -350,19 +350,23 @@ class ComputationGraph:
     def fit(self, data=None, labels=None, *, epochs: int = 1,
             batch_size: Optional[int] = None, iterator=None, dataset=None,
             async_prefetch: bool = True, prefetch_depth: int = 2,
-            steps_per_dispatch: int = 1):
+            steps_per_dispatch: int = 1, skip_first_batches: int = 0):
         """``async_prefetch``/``prefetch_depth``: iterator feeds (incl.
         MultiDataSet multi-input batches) run through a
         DevicePrefetchIterator — see MultiLayerNetwork.fit.
 
         ``steps_per_dispatch=K`` fuses K-step windows into one lax.scan
         program (see MultiLayerNetwork.fit); multi-input MultiDataSet
-        batches are not stackable and run per-step."""
+        batches are not stackable and run per-step.
+
+        ``skip_first_batches=S``: mid-epoch resume — see
+        MultiLayerNetwork.fit."""
         self._solver().fit(data=data, labels=labels, epochs=epochs,
                            batch_size=batch_size, iterator=iterator,
                            dataset=dataset, async_prefetch=async_prefetch,
                            prefetch_depth=prefetch_depth,
-                           steps_per_dispatch=steps_per_dispatch)
+                           steps_per_dispatch=steps_per_dispatch,
+                           skip_first_batches=skip_first_batches)
         return self
 
     def pretrain(self, iterator, epochs: int = 1):

@@ -330,7 +330,8 @@ class MultiLayerNetwork:
 
     def fit(self, data=None, labels=None, *, epochs: int = 1, batch_size: Optional[int] = None,
             iterator=None, dataset=None, async_prefetch: bool = True,
-            prefetch_depth: int = 2, steps_per_dispatch: int = 1):
+            prefetch_depth: int = 2, steps_per_dispatch: int = 1,
+            skip_first_batches: int = 0):
         """``async_prefetch``/``prefetch_depth``: iterator feeds run through
         a DevicePrefetchIterator (datasets/prefetch.py) — batch N+1 is
         host-prepared AND shipped to the device while step N computes; the
@@ -340,12 +341,19 @@ class MultiLayerNetwork:
         batches into ONE jitted lax.scan training program (one host
         round-trip per window instead of per step) — bit-identical to K
         sequential steps; tBPTT, second-order solvers, and ragged
-        remainder windows automatically run per-step."""
+        remainder windows automatically run per-step.
+
+        ``skip_first_batches=S``: consume (don't train) the first S
+        batches of the FIRST epoch — the mid-epoch resume plumbing used
+        by ``fit_with_checkpointing`` when a preemption landed between
+        epoch boundaries (``iteration_count`` restored from the
+        checkpoint already covers the skipped steps)."""
         self._solver().fit(data=data, labels=labels, epochs=epochs,
                            batch_size=batch_size, iterator=iterator,
                            dataset=dataset, async_prefetch=async_prefetch,
                            prefetch_depth=prefetch_depth,
-                           steps_per_dispatch=steps_per_dispatch)
+                           steps_per_dispatch=steps_per_dispatch,
+                           skip_first_batches=skip_first_batches)
         return self
 
     def pretrain(self, iterator, epochs: int = 1):

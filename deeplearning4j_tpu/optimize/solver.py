@@ -22,7 +22,7 @@ import numpy as np
 
 from ..datasets.dataset import DataSet, ListDataSetIterator
 from ..datasets.prefetch import (BatchWindow, DevicePrefetchIterator,
-                                 iter_windows)
+                                 iter_windows, skip_batches)
 from ..telemetry import device_memory_gauges, get_registry, span
 from .listeners import PerformanceListener, TrainingListener
 
@@ -198,12 +198,15 @@ class Solver:
     # ------------------------------------------------------------------- fit
     def fit(self, data=None, labels=None, *, epochs=1, batch_size=None,
             iterator=None, dataset=None, async_prefetch: bool = True,
-            prefetch_depth: int = 2, steps_per_dispatch: int = 1):
+            prefetch_depth: int = 2, steps_per_dispatch: int = 1,
+            skip_first_batches: int = 0):
         net = self.net
         if net.params is None:
             net.init()
         if steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if skip_first_batches < 0:
+            raise ValueError("skip_first_batches must be >= 0")
         tbptt = net.conf.backprop_type == "tbptt"
         algo = getattr(net.conf, "optimization_algorithm", "sgd")
         if algo in ("sgd", "stochastic_gradient_descent"):
@@ -275,11 +278,14 @@ class Solver:
                 with span("epoch", index=epoch):
                     self._fit_epoch(net, it_wrapped, prefetcher, iterator,
                                     dtype, base_rng, perf, fused_k, tbptt,
-                                    second_order, reg)
+                                    second_order, reg,
+                                    skip=(skip_first_batches
+                                          if epoch == 0 else 0))
         return net
 
     def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, dtype,
-                   base_rng, perf, fused_k, tbptt, second_order, reg):
+                   base_rng, perf, fused_k, tbptt, second_order, reg,
+                   skip: int = 0):
         for l in net.listeners:
             if isinstance(l, TrainingListener):
                 l.on_epoch_start(net)
@@ -296,8 +302,19 @@ class Solver:
         # once per epoch, one lock-protected int add per iteration
         _c_iters = reg.counter("train.iterations")
         _c_windows = reg.counter("train.windows")
-        stream = (iter_windows(it_wrapped, fused_k) if fused_k > 1
-                  else it_wrapped)
+        # Mid-epoch resume (fit_with_checkpointing / ElasticTrainer): the
+        # first `skip` batches of this epoch were already trained by the
+        # run that wrote the checkpoint — consume them without dispatching
+        # (iteration_count already covers them) so the epoch isn't
+        # replayed. Skipping BEFORE windowing keeps the window grid a
+        # plain positional grouping of the remaining stream; per-batch
+        # math is grouping-invariant (the scan-window contract).
+        src = skip_batches(it_wrapped, skip) if skip else iter(it_wrapped)
+        if skip:
+            _etl_t0 = time.perf_counter()
+            if prefetcher is not None:
+                _etl_prev_total = prefetcher.total_wait_ms
+        stream = iter_windows(src, fused_k) if fused_k > 1 else src
         for item in stream:
             if prefetcher is not None:
                 # delta of the cumulative wait covers both a single

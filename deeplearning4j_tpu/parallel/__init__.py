@@ -3,8 +3,16 @@ from .data_parallel import ParallelWrapper
 from .inference import ParallelInference
 from .overlap import (BucketSchedule, GradBucket, build_bucket_schedule,
                       bucketed_pmean, fused_pmean, profile_schedule)
+from .elastic import ElasticTrainer, RecoveryFailedError
+from .faults import (CoordinationError, CoordinationFlake, CorruptCheckpoint,
+                     FaultInjector, FaultPlan, KillWorker, PreemptAt,
+                     SlowCollective, WorkerLostError)
 
 __all__ = ["data_sharding", "make_mesh", "replicated", "window_sharding",
            "ParallelWrapper", "ParallelInference",
            "BucketSchedule", "GradBucket", "build_bucket_schedule",
-           "bucketed_pmean", "fused_pmean", "profile_schedule"]
+           "bucketed_pmean", "fused_pmean", "profile_schedule",
+           "ElasticTrainer", "RecoveryFailedError",
+           "FaultInjector", "FaultPlan", "KillWorker", "SlowCollective",
+           "CorruptCheckpoint", "PreemptAt", "CoordinationFlake",
+           "WorkerLostError", "CoordinationError"]

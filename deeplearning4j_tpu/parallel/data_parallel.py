@@ -34,7 +34,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import AsyncDataSetIterator
-from ..datasets.prefetch import BatchWindow, DevicePrefetchIterator, iter_windows
+from ..datasets.prefetch import (BatchWindow, DevicePrefetchIterator,
+                                 iter_windows, skip_batches)
 from ..optimize.listeners import PerformanceListener, TrainingListener
 from ..optimize.solver import cast_feed, train_step_math
 from ..telemetry import get_registry, span
@@ -93,7 +94,8 @@ class ParallelWrapper:
                  report_score_after_averaging: bool = True,
                  gradient_accumulator=None, steps_per_dispatch: int = 1,
                  overlap_sync: bool = False,
-                 bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 step_callback=None):
         self.net = net
         devices = jax.devices()
         if workers is not None and mesh is None:
@@ -157,6 +159,14 @@ class ParallelWrapper:
         self._remainder_step = None
         self._remainder_window_step = None
         self._avg_steps = {}   # keyed by chunk count (remainder batches differ)
+        # Supervision seam (parallel/elastic.py): called as
+        # step_callback(net, k) AFTER a dispatched item's k iterations are
+        # fully accounted (params, iteration_count, listeners all
+        # consistent) — the one safe place to raise control-flow out of
+        # the epoch (worker-loss, preemption, mode switches, step budget).
+        # Raising from a TrainingListener.iteration_done instead would
+        # strand iteration_count behind params mid-item.
+        self.step_callback = step_callback
 
     # ------------------------------------------------------------- sync path
     def _build_sync_step(self, feed_sharding=None):
@@ -348,9 +358,16 @@ class ParallelWrapper:
         return jnp.broadcast_to(per_worker, (self.n,) + per_worker.shape).copy()
 
     # -------------------------------------------------------- averaging path
-    def _build_avg_step(self):
+    def _build_avg_step(self, replicated_feed: bool = False):
         """K local steps per device, then pmean of params (+updater state):
-        the reference's averagingFrequency semantics, one XLA program."""
+        the reference's averagingFrequency semantics, one XLA program.
+
+        ``replicated_feed``: serves batches whose size does not tile the
+        mesh (e.g. after an elastic recovery shrank the mesh): every
+        worker runs the SAME K full-batch steps and the pmean of
+        identical trajectories is a no-op — degenerate but well-defined
+        averaging, instead of the shard_map divisibility error killing
+        the epoch."""
         net = self.net
         mesh = self.mesh
         K = self.averaging_frequency
@@ -386,7 +403,8 @@ class ParallelWrapper:
             return params, state, opt_state, mean_loss
 
         rep_spec = P()
-        dsh_spec = P(None, "data")  # [K, batch, ...] -> shard batch dim
+        # [K, batch, ...] -> shard batch dim; replicated when it can't tile
+        dsh_spec = rep_spec if replicated_feed else P(None, "data")
         fn = shard_map(worker_steps, mesh=mesh,
                        in_specs=(rep_spec, rep_spec, rep_spec, rep_spec, rep_spec,
                                  dsh_spec, dsh_spec),
@@ -395,8 +413,10 @@ class ParallelWrapper:
         return jax.jit(fn, donate_argnums=(0, 2))
 
     # ------------------------------------------------------------------- fit
-    def fit(self, iterator, epochs: int = 1):
+    def fit(self, iterator, epochs: int = 1, *, skip_first_batches: int = 0):
         net = self.net
+        if skip_first_batches < 0:
+            raise ValueError("skip_first_batches must be >= 0")
         if net.params is None:
             net.init()
         sync = self.training_mode == "shared_gradients" or self.averaging_frequency == 1
@@ -437,10 +457,16 @@ class ParallelWrapper:
             # one [K, B, ...] feed, so a device-resident batch would just
             # round-trip device->host->device. Unwrap a caller-supplied
             # DevicePrefetchIterator to its base for the same reason.
+            # prefetch_buffer < 1 opts out of the async wrapper entirely
+            # (ElasticTrainer's degraded mode relies on this: a background
+            # producer racing a recovery-time iterator reset() would make
+            # the resumed data stream nondeterministic, and Queue(0) is
+            # UNBOUNDED — it would buffer the whole epoch on host).
             base = (iterator.base
                     if isinstance(iterator, DevicePrefetchIterator)
                     else iterator)
-            it_wrapped = AsyncDataSetIterator(base, self.prefetch_buffer)
+            it_wrapped = (AsyncDataSetIterator(base, self.prefetch_buffer)
+                          if self.prefetch_buffer >= 1 else base)
             prefetcher = None
 
         # historical ParallelWrapper semantics: EVERYTHING to dtype (the
@@ -454,17 +480,23 @@ class ParallelWrapper:
             for epoch in range(epochs):
                 with span("epoch", index=epoch):
                     self._fit_epoch(net, it_wrapped, prefetcher, iterator,
-                                    feed, dtype, base_rng, perf, sync, reg)
+                                    feed, dtype, base_rng, perf, sync, reg,
+                                    skip=(skip_first_batches
+                                          if epoch == 0 else 0))
         return net
 
     def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, feed, dtype,
-                   base_rng, perf, sync, reg):
+                   base_rng, perf, sync, reg, skip: int = 0):
         for l in net.listeners:
             if isinstance(l, TrainingListener):
                 l.on_epoch_start(net)
+        # mid-epoch resume: batches the checkpointed run already trained
+        # are consumed, not dispatched (see Solver._fit_epoch)
+        src = skip_batches(it_wrapped, skip) if skip else iter(it_wrapped)
         if sync:
             _t0 = time.perf_counter()
-            _etl_prev_total = 0.0
+            _etl_prev_total = (prefetcher.total_wait_ms
+                               if (skip and prefetcher is not None) else 0.0)
             # hoisted like Solver._fit_epoch: metric name resolution once
             # per epoch, one locked int add per iteration
             _c_iters = reg.counter("train.iterations")
@@ -476,8 +508,8 @@ class ParallelWrapper:
             _n_coll = (_n_buckets + 1) if self.overlap_sync else 0
             windowed = (self.steps_per_dispatch > 1
                         and self.gradient_accumulator is None)
-            stream = (iter_windows(it_wrapped, self.steps_per_dispatch)
-                      if windowed else it_wrapped)
+            stream = (iter_windows(src, self.steps_per_dispatch)
+                      if windowed else src)
             for item in stream:
                 if prefetcher is not None:
                     etl_ms = prefetcher.total_wait_ms - _etl_prev_total
@@ -520,6 +552,8 @@ class ParallelWrapper:
                                          etl_wait_ms=etl_ms / k,
                                          device_ms=device_ms / k)
                             net.iteration_count += 1
+                    if self.step_callback is not None:
+                        self.step_callback(net, k)
                     _t0 = time.perf_counter()
                     continue
                 ds = item
@@ -555,11 +589,13 @@ class ParallelWrapper:
                     self._notify(perf, ds, loss, etl_wait_ms=etl_ms,
                                  device_ms=device_ms)
                     net.iteration_count += 1
+                if self.step_callback is not None:
+                    self.step_callback(net, 1)
                 _t0 = time.perf_counter()
         else:
             # accumulate K batches then run the fused K-step+average program
             buf: List[Any] = []
-            for ds in it_wrapped:
+            for ds in src:
                 buf.append(ds)
                 if len(buf) == self.averaging_frequency:
                     self._run_avg(buf, base_rng, dtype, perf)
@@ -579,9 +615,15 @@ class ParallelWrapper:
             xs = jnp.stack([jnp.asarray(np.asarray(d.features), dtype) for d in buf])
             ys = jnp.stack([jnp.asarray(np.asarray(d.labels), dtype) for d in buf])
             rng = jax.random.fold_in(base_rng, net.iteration_count)
-            step = self._avg_steps.get(len(buf))
+            # remainder batches (size not tiling the mesh) dispatch the
+            # replicated-feed averaging program — same contract as the
+            # sync path's remainder fallback
+            rem = xs.shape[1] % self.n != 0
+            key = (len(buf), rem)
+            step = self._avg_steps.get(key)
             if step is None:
-                step = self._avg_steps[len(buf)] = self._build_avg_step()
+                step = self._avg_steps[key] = \
+                    self._build_avg_step(replicated_feed=rem)
             with span("dispatch", k=len(buf)):
                 net.params, net.state, net.opt_state, loss = step(
                     net.params, net.state, net.opt_state,
@@ -594,6 +636,8 @@ class ParallelWrapper:
             for d in buf:
                 self._notify(perf, d, loss)
                 net.iteration_count += 1
+        if self.step_callback is not None:
+            self.step_callback(net, len(buf))
 
     def _notify(self, perf, ds, loss, etl_wait_ms: float = 0.0,
                 device_ms: float = 0.0):

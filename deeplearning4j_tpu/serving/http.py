@@ -35,14 +35,26 @@ from __future__ import annotations
 
 import json
 import threading
+import zipfile
 from typing import Optional
 
 import numpy as np
 
+from ..util.retry import RetryError, RetryPolicy
 from .engine import InferenceEngine
 from .errors import (BlockPoolExhaustedError, DeadlineExceededError,
                      DrainingError, QueueFullError, ShapeMismatchError,
                      UnknownModelError)
+
+# /reload checkpoint loads ride shared storage that can flake mid-read
+# (NFS hiccup, object-store gateway timeout, a checkpoint zip still
+# landing): retry transient I/O with capped backoff before answering
+# 500. A missing path is NOT transient — FileNotFoundError stays a fast
+# 400 (util/retry's `retryable` filter, not a blanket except).
+_RELOAD_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.05, max_delay_s=0.25,
+    retryable=lambda e: (isinstance(e, (OSError, zipfile.BadZipFile))
+                         and not isinstance(e, FileNotFoundError)))
 
 _STATUS = ((ShapeMismatchError, 400), (UnknownModelError, 404),
            (QueueFullError, 429), (DrainingError, 503),
@@ -291,9 +303,14 @@ class ServingHTTPServer:
                 # file changes between loads)
                 try:
                     from .registry import load_net
-                    net = load_net(path)
+                    net = _RELOAD_RETRY.call(load_net, path)
                 except FileNotFoundError as e:
                     write_json(self, 400, {"error": str(e)})
+                    return
+                except RetryError as e:
+                    write_json(self, 500,
+                               {"error": f"failed to load {path!r} after "
+                                         f"{e.attempts} attempts: {e.last}"})
                     return
                 except Exception as e:
                     write_json(self, 500,

@@ -9,106 +9,231 @@ zip, TPU-preemption style).
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
 import re
 import tempfile
-from typing import Any, List, Optional
+import zipfile
+from typing import Any, List, Optional, Tuple
 
 from ..optimize.listeners import TrainingListener
-from .serialization import restore_model, write_model
+from .serialization import MANIFEST_ENTRY, restore_model, write_model
 
 _log = logging.getLogger("deeplearning4j_tpu")
 
-_CKPT_RE = re.compile(r"^checkpoint_epoch(\d+)\.zip$")
+# boundary saves: checkpoint_epoch{E}.zip        (E epochs fully done)
+# mid-epoch saves: checkpoint_epoch{E}_step{S}.zip (E done + S steps into
+# epoch E+1) — sort key (E, S), boundary == (E, 0)
+_CKPT_RE = re.compile(r"^checkpoint_epoch(\d+)(?:_step(\d+))?\.zip$")
 
 
 class CheckpointListener(TrainingListener):
-    """Writes ``checkpoint_epoch{N}.zip`` at epoch boundaries (atomic rename
-    so a preemption mid-write never leaves a truncated newest checkpoint),
-    keeping the last ``keep_last``."""
+    """Writes checkpoints at epoch boundaries — and, with
+    ``every_n_iterations=N``, every N steps WITHIN an epoch, so a
+    preemption mid-epoch resumes without replaying the whole epoch
+    (``fit_with_checkpointing`` reads the position back from the zip
+    manifest). Writes are atomic-rename, keeping the newest
+    ``keep_last``.
+
+    Mid-epoch saves require the per-step dispatch path
+    (``steps_per_dispatch=1``, the ``fit_with_checkpointing`` default):
+    inside a fused K-step scan window the listener fan-out happens AFTER
+    the whole window ran, so a mid-window save would store window-END
+    params under a mid-window step label and a resume would re-apply the
+    window tail. Epoch-boundary saves are window-aligned by construction
+    and safe under any K.
+
+    Pruning only ever touches checkpoints strictly older than the last
+    write THIS listener completed: a checkpoint being written
+    concurrently (an async writer, another process sharing the
+    directory) is newer than our last completed write and is therefore
+    never counted against ``keep_last`` nor deleted under a reader that
+    just resolved it as "latest"."""
 
     def __init__(self, directory: str, every_n_epochs: int = 1,
-                 keep_last: int = 3, save_updater: bool = True):
+                 keep_last: int = 3, save_updater: bool = True,
+                 every_n_iterations: Optional[int] = None):
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
         self.every_n_epochs = max(1, every_n_epochs)
         self.keep_last = keep_last
         self.save_updater = save_updater
+        self.every_n_iterations = every_n_iterations
         self._epoch = 0
+        self._step = 0                      # step within the current epoch
+        self._last_completed: Optional[Tuple[int, int]] = None
 
     def iteration_done(self, model, iteration, score):
-        pass
+        self._step += 1
+        if not self.every_n_iterations:
+            return
+        if self._step % self.every_n_iterations:
+            return
+        self._write(model, self._epoch, self._step)
 
     def on_epoch_start(self, model):
         pass
 
     def on_epoch_end(self, model):
         self._epoch += 1
+        self._step = 0
         if self._epoch % self.every_n_epochs:
             return
-        final = os.path.join(self.directory,
-                             f"checkpoint_epoch{self._epoch}.zip")
+        self._write(model, self._epoch, 0)
+
+    def _write(self, model, epoch: int, step: int):
+        name = (f"checkpoint_epoch{epoch}.zip" if step == 0
+                else f"checkpoint_epoch{epoch}_step{step}.zip")
+        final = os.path.join(self.directory, name)
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         os.close(fd)
+        # iterations_done: listeners run BEFORE iteration_count increments,
+        # so a mid-epoch save must record count+1 (the step it just
+        # finished is done); at an epoch boundary the count is already
+        # post-increment. Resume restores this value so the rng/schedule
+        # stream (fold_in(base_rng, iteration)) lines up exactly.
+        it = getattr(model, "iteration_count", 0)
         try:
-            write_model(model, tmp, save_updater=self.save_updater)
+            write_model(model, tmp, save_updater=self.save_updater,
+                        extra_manifest={
+                            "epochs_done": epoch,
+                            "step_within_epoch": step,
+                            "iterations_done": it + 1 if step else it})
             os.replace(tmp, final)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
+        self._last_completed = (epoch, step)
         self._prune()
 
     def _prune(self):
-        ckpts = list_checkpoints(self.directory)
-        for path, _ in ckpts[:-self.keep_last]:
-            os.unlink(path)
+        if self._last_completed is None:
+            return
+        # only checkpoints <= the last write WE completed are candidates:
+        # anything newer may be another writer's in-flight save or a file
+        # a concurrent reader just resolved — not ours to count or delete
+        done = [(path, key) for path, key in _scan_checkpoints(self.directory)
+                if key <= self._last_completed]
+        for path, _ in done[:-self.keep_last]:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
 
 
-def list_checkpoints(directory: str) -> List[tuple]:
-    """[(path, epoch)] sorted by epoch ascending."""
+def _scan_checkpoints(directory: str) -> List[tuple]:
+    """[(path, (epoch, step))] sorted ascending by (epoch, step)."""
     out = []
     if os.path.isdir(directory):
         for name in os.listdir(directory):
             m = _CKPT_RE.match(name)
             if m:
-                out.append((os.path.join(directory, name), int(m.group(1))))
+                out.append((os.path.join(directory, name),
+                            (int(m.group(1)), int(m.group(2) or 0))))
     return sorted(out, key=lambda t: t[1])
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
-    ckpts = list_checkpoints(directory)
-    return ckpts[-1][0] if ckpts else None
+def list_checkpoints(directory: str) -> List[tuple]:
+    """[(path, epoch)] sorted ascending by (epoch, step-within-epoch)."""
+    return [(path, key[0]) for path, key in _scan_checkpoints(directory)]
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    """Cheap structural validation: a readable zip whose manifest (when
+    it is one of ours) parses. A preemption mid-copy or a truncated
+    download loses the zip central directory, which ``is_zipfile``
+    catches without reading the payload; foreign (reference-format DL4J)
+    zips without our manifest pass on zip readability alone."""
+    try:
+        if not zipfile.is_zipfile(path):
+            return False
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            if MANIFEST_ENTRY in names:
+                json.loads(z.read(MANIFEST_ENTRY).decode())
+            return bool(names)
+    except Exception:
+        return False
+
+
+def read_checkpoint_manifest(path: str) -> dict:
+    """The manifest dict of a checkpoint zip ({} if absent/foreign)."""
+    try:
+        with zipfile.ZipFile(path) as z:
+            return json.loads(z.read(MANIFEST_ENTRY).decode())
+    except Exception:
+        return {}
+
+
+def latest_checkpoint(directory: str, validate: bool = True) -> Optional[str]:
+    """Newest VALID checkpoint — a truncated/corrupt newest entry falls
+    back to the previous one instead of handing the caller a zip that
+    will crash on restore (``validate=False`` restores the old
+    trust-the-newest behavior)."""
+    for path, _ in reversed(_scan_checkpoints(directory)):
+        if not validate or is_valid_checkpoint(path):
+            return path
+        _log.warning("checkpoint %s is truncated/corrupt; falling back to "
+                     "the previous checkpoint", path)
+    return None
 
 
 def fit_with_checkpointing(net, iterator, *, epochs: int, checkpoint_dir: str,
                            every_n_epochs: int = 1, keep_last: int = 3,
-                           load_updater: bool = True):
-    """Resumable training loop: restores the newest checkpoint in
-    ``checkpoint_dir`` (params + updater state), then trains only the
-    REMAINING epochs, checkpointing as it goes. Safe to re-run after a crash
-    or preemption — the loop continues where the newest checkpoint left off.
-    Returns (net, epochs_actually_run).
+                           load_updater: bool = True,
+                           every_n_iterations: Optional[int] = None):
+    """Resumable training loop: restores the newest VALID checkpoint in
+    ``checkpoint_dir`` (params + updater state; truncated/corrupt newer
+    saves are skipped), then trains only the REMAINING work,
+    checkpointing as it goes. Safe to re-run after a crash or preemption
+    — the loop continues where the newest checkpoint left off.
+
+    With ``every_n_iterations=N`` checkpoints also land every N steps
+    within an epoch; a resume then skips the already-trained prefix of
+    the interrupted epoch (``step_within_epoch`` from the manifest)
+    instead of replaying it. Checkpoints written before this key existed
+    are treated as epoch-boundary saves. Returns
+    (net, epochs_actually_run) — a resumed partial epoch counts as one.
     """
-    done = 0
-    latest = latest_checkpoint(checkpoint_dir)
-    if latest is not None:
-        restored = restore_model(latest, load_updater=load_updater)
+    done, step_in_epoch = 0, 0
+    restored = None
+    for path, key in reversed(_scan_checkpoints(checkpoint_dir)):
+        if not is_valid_checkpoint(path):
+            _log.warning("checkpoint %s is truncated/corrupt; falling back "
+                         "to the previous checkpoint", path)
+            continue
+        try:
+            restored = restore_model(path, load_updater=load_updater)
+        except Exception as e:
+            _log.warning("checkpoint %s failed to restore (%s); falling "
+                         "back to the previous checkpoint", path, e)
+            continue
+        manifest = read_checkpoint_manifest(path)
+        done = int(manifest.get("epochs_done", key[0]))
+        # missing key == epoch-boundary save (pre-mid-epoch format)
+        step_in_epoch = int(manifest.get("step_within_epoch", 0))
+        break
+    if restored is not None:
         if net.params is None:
             net.init()
         net.set_params_flat(restored.params_flat())
         if load_updater and restored.opt_state is not None:
             net.opt_state = restored.opt_state
-        done = list_checkpoints(checkpoint_dir)[-1][1]
+        net.iteration_count = int(manifest.get("iterations_done",
+                                               restored.iteration_count))
     remaining = max(0, epochs - done)
     if remaining == 0:
         return net, 0
-    listener = CheckpointListener(checkpoint_dir, every_n_epochs, keep_last)
+    listener = CheckpointListener(checkpoint_dir, every_n_epochs, keep_last,
+                                  every_n_iterations=every_n_iterations)
     listener._epoch = done
+    listener._step = step_in_epoch
     saved = list(net.listeners)
     net.set_listeners(*(saved + [listener]))
     try:
-        net.fit(iterator=iterator, epochs=remaining)
+        net.fit(iterator=iterator, epochs=remaining,
+                skip_first_batches=step_in_epoch)
     finally:
         net.set_listeners(*saved)
     return net, remaining

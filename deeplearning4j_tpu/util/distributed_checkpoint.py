@@ -24,13 +24,17 @@ Design (TPU-first, no torch.save-style pickles):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import tempfile
-from typing import Any, List, Optional, Tuple
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+_log = logging.getLogger("deeplearning4j_tpu")
 
 _MANIFEST_RE = re.compile(r"^ckpt_step(\d+)\.json$")
 
@@ -47,12 +51,18 @@ def _norm_index(index: Tuple[slice, ...], shape: Tuple[int, ...]):
     return out
 
 
-def save_sharded_checkpoint(directory: str, step: int, tree: Any) -> str:
+def save_sharded_checkpoint(directory: str, step: int, tree: Any,
+                            extra: Optional[Dict[str, Any]] = None) -> str:
     """Write this process's shards of ``tree`` (any pytree of jax.Arrays —
     bundle params/opt_state/state/it as a dict) + the manifest. Returns the
     manifest path. In a multi-process run every process MUST call this (each
     writes its own file); the manifest is written by process 0. Callers on a
-    pod should barrier between save and any restore."""
+    pod should barrier between save and any restore.
+
+    ``extra`` is a JSON-serializable dict stored verbatim in the manifest
+    (read back via :func:`read_manifest`): the elastic trainer keeps its
+    resume metadata there (``step_in_epoch``, ``epoch_len``) so a resumed
+    run can skip to the right position without replaying the epoch."""
     os.makedirs(directory, exist_ok=True)
     leaves = jax.tree.leaves(tree)
     pidx = jax.process_index()
@@ -92,7 +102,8 @@ def save_sharded_checkpoint(directory: str, step: int, tree: Any) -> str:
                 json.dump({"step": step,
                            "num_processes": jax.process_count(),
                            "n_leaves": len(leaves),
-                           "leaves": meta_leaves}, f)
+                           "leaves": meta_leaves,
+                           "extra": dict(extra or {})}, f)
             os.replace(tmp, manifest)
         finally:
             if os.path.exists(tmp):
@@ -138,12 +149,73 @@ def is_complete(directory: str, step: int) -> bool:
     return len(_shard_files(directory, step)) >= n_expected
 
 
+def read_manifest(directory: str, step: int) -> Optional[dict]:
+    """The manifest dict for ``step`` (incl. its ``extra`` resume metadata),
+    or None if missing/unreadable."""
+    try:
+        with open(os.path.join(directory, f"ckpt_step{step}.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_valid(directory: str, step: int) -> bool:
+    """``is_complete`` AND every shard file is a readable archive.
+
+    A preemption can truncate a shard file mid-write even when the rename
+    discipline keeps the manifest honest on THIS filesystem (network
+    filesystems and object-store gateways don't all give atomic rename),
+    and bit rot / partial copies happen to real checkpoints. ``.npz`` is
+    a zip: a truncated or overwritten tail loses the central directory,
+    which ``zipfile.is_zipfile`` detects without reading the payload —
+    cheap enough to run on every restore candidate. Member-level
+    corruption that keeps the directory intact is caught later by the
+    CRC check during the actual read (see
+    :func:`restore_latest_sharded_checkpoint`'s fallback)."""
+    if not is_complete(directory, step):
+        return False
+    for path in _shard_files(directory, step):
+        try:
+            if not zipfile.is_zipfile(path):
+                return False
+        except OSError:
+            return False
+    return True
+
+
 def latest_sharded_step(directory: str) -> Optional[int]:
-    """Newest COMPLETE step (all shard files present), or None."""
+    """Newest COMPLETE and VALID step, or None."""
     for step, _ in reversed(list_sharded_checkpoints(directory)):
-        if is_complete(directory, step):
+        if is_valid(directory, step):
             return step
     return None
+
+
+def restore_latest_sharded_checkpoint(directory: str, like: Any
+                                      ) -> Tuple[Optional[int], Any, dict]:
+    """Restore the newest checkpoint that actually loads, walking backwards
+    past incomplete, truncated, or corrupt saves instead of crashing on
+    the newest entry. Returns ``(step, tree, extra)`` — or
+    ``(None, like, {})`` when nothing in the directory is restorable.
+
+    This is the recovery entry point: after a preemption the newest save
+    is exactly the one most likely to be damaged (the writer died
+    mid-stream), so trusting it is how a cluster run turns one lost
+    worker into a lost job."""
+    for step, _ in reversed(list_sharded_checkpoints(directory)):
+        if not is_valid(directory, step):
+            _log.warning("checkpoint step %d in %s is incomplete/truncated; "
+                         "falling back to an older save", step, directory)
+            continue
+        try:
+            tree = restore_sharded_checkpoint(directory, step, like)
+        except Exception as e:  # corrupt member, CRC, topology mismatch
+            _log.warning("checkpoint step %d in %s failed to restore (%s); "
+                         "falling back to an older save", step, directory, e)
+            continue
+        manifest = read_manifest(directory, step) or {}
+        return step, tree, dict(manifest.get("extra") or {})
+    return None, like, {}
 
 
 def restore_sharded_checkpoint(directory: str, step: int, like: Any) -> Any:
@@ -244,11 +316,11 @@ class DistributedCheckpointer:
         return latest_sharded_step(self.directory)
 
     def restore_latest(self, like: Any) -> Tuple[Optional[int], Any]:
-        """(step, tree) from the newest complete save, or (None, like)."""
-        step = self.latest()
-        if step is None:
-            return None, like
-        return step, restore_sharded_checkpoint(self.directory, step, like)
+        """(step, tree) from the newest save that actually restores —
+        incomplete/truncated/corrupt newer saves are skipped, not fatal
+        (see restore_latest_sharded_checkpoint) — or (None, like)."""
+        step, tree, _ = restore_latest_sharded_checkpoint(self.directory, like)
+        return step, tree
 
     def _prune(self):
         """Keep the newest ``keep_last`` COMPLETE saves. Incomplete steps

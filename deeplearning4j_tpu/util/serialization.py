@@ -44,8 +44,14 @@ def _unflatten_like(tree, flat: np.ndarray):
     return jax.tree.unflatten(treedef, out)
 
 
-def write_model(net, path: str, save_updater: bool = True):
-    """Persist a MultiLayerNetwork (or ComputationGraph) to a model zip."""
+def write_model(net, path: str, save_updater: bool = True,
+                extra_manifest: Optional[dict] = None):
+    """Persist a MultiLayerNetwork (or ComputationGraph) to a model zip.
+
+    ``extra_manifest``: JSON-serializable keys merged into the manifest
+    (checkpointing stores its resume position — ``epochs_done``,
+    ``step_within_epoch`` — there; readers treat a missing key as an
+    epoch-boundary save, so old zips stay loadable)."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(CONFIG_ENTRY, net.conf.to_json())
         params_flat = _flatten_tree(net.params).astype(np.float32)
@@ -62,6 +68,8 @@ def write_model(net, path: str, save_updater: bool = True):
             upd_flat = _flatten_tree(net.opt_state).astype(np.float32)
             z.writestr(UPDATER_ENTRY, upd_flat.tobytes())
             manifest["n_updater_state"] = int(upd_flat.size)
+        if extra_manifest:
+            manifest.update(extra_manifest)
         z.writestr(MANIFEST_ENTRY, json.dumps(manifest))
 
 

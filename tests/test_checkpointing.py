@@ -1,5 +1,7 @@
 """Checkpoint-restart orchestration + profiler hookup (SURVEY.md §5.1/§5.3:
-periodic checkpoints, resume-after-preemption, XProf trace capture)."""
+periodic checkpoints, resume-after-preemption, XProf trace capture) —
+incl. corrupt-checkpoint fallback, mid-epoch resume, and the prune
+last-completed-write contract."""
 import os
 
 import numpy as np
@@ -8,12 +10,15 @@ import pytest
 from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
 from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
 from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.optimize.updaters import Adam
 from deeplearning4j_tpu.util.checkpointing import (CheckpointListener,
                                                    ProfilerListener,
                                                    fit_with_checkpointing,
+                                                   is_valid_checkpoint,
                                                    latest_checkpoint,
-                                                   list_checkpoints)
+                                                   list_checkpoints,
+                                                   read_checkpoint_manifest)
 
 R = np.random.default_rng(29)
 
@@ -68,6 +73,153 @@ def test_fit_with_checkpointing_resumes(tmp_path):
     saved = restore_model(latest_checkpoint(d))
     np.testing.assert_allclose(np.asarray(c.params_flat()),
                                np.asarray(saved.params_flat()), atol=1e-6)
+
+
+def _params(net):
+    return np.asarray(net.params_flat())
+
+
+def test_latest_checkpoint_skips_truncated_newest(tmp_path):
+    """A truncated newest checkpoint (preemption mid-copy) must fall back
+    to the previous one instead of being handed to restore_model."""
+    net = _net()
+    it, _, _ = _it()
+    net.set_listeners(CheckpointListener(str(tmp_path), keep_last=5))
+    net.fit(iterator=it, epochs=3)
+    newest = os.path.join(str(tmp_path), "checkpoint_epoch3.zip")
+    with open(newest, "r+b") as f:
+        f.truncate(40)
+    assert not is_valid_checkpoint(newest)
+    assert latest_checkpoint(str(tmp_path)).endswith("epoch2.zip")
+    # trust-the-newest escape hatch preserved
+    assert latest_checkpoint(str(tmp_path), validate=False).endswith(
+        "epoch3.zip")
+
+
+def test_fit_with_checkpointing_falls_back_on_corrupt_newest(tmp_path):
+    d = str(tmp_path / "ck")
+    it, x, y = _it()
+    a = _net()
+    fit_with_checkpointing(a, it, epochs=3, checkpoint_dir=d, keep_last=5)
+    it.reset()
+    with open(os.path.join(d, "checkpoint_epoch3.zip"), "r+b") as f:
+        f.truncate(40)
+    # resume: epoch-3 save is damaged -> restart from epoch 2, rerun 4
+    b = _net()
+    b2, ran = fit_with_checkpointing(b, it, epochs=6, checkpoint_dir=d,
+                                     keep_last=5)
+    assert ran == 4
+    assert latest_checkpoint(d).endswith("epoch6.zip")
+
+
+class _RaiseAt(TrainingListener):
+    """Simulated hard crash at a global iteration index."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def __init__(self, at):
+        self.at = at
+
+    def iteration_done(self, model, iteration, score):
+        if iteration == self.at:
+            raise self.Boom(f"crash at iteration {iteration}")
+
+
+def test_mid_epoch_resume_does_not_replay_epoch(tmp_path):
+    """every_n_iterations checkpoints + step_within_epoch in the manifest:
+    a crash mid-epoch resumes at the exact step — bit-identical to an
+    uninterrupted run, not a whole-epoch replay."""
+    d = str(tmp_path / "ck")
+    # ONE dataset, a fresh iterator object per run (a crashed run's
+    # abandoned prefetcher must not share iterator state with the resume)
+    _, x, y = _it()
+
+    def fresh_it():
+        return ListDataSetIterator(features=x, labels=y, batch_size=32)
+
+    # uninterrupted baseline: 3 epochs of 4 batches (128/32)
+    a = _net()
+    a.fit(iterator=fresh_it(), epochs=3, async_prefetch=False)
+
+    # crashed run: dies at global iteration 6 (step 3 of epoch 2)
+    b = _net()
+    b.set_listeners(_RaiseAt(6))
+    with pytest.raises(_RaiseAt.Boom):
+        fit_with_checkpointing(b, fresh_it(), epochs=3, checkpoint_dir=d,
+                               every_n_iterations=2, keep_last=10)
+    # newest checkpoint: 1 epoch done + 2 steps into epoch 2
+    newest = latest_checkpoint(d)
+    assert newest.endswith("epoch1_step2.zip")
+    m = read_checkpoint_manifest(newest)
+    assert (m["epochs_done"], m["step_within_epoch"]) == (1, 2)
+    assert m["iterations_done"] == 6
+
+    # fresh "process" resumes: must NOT replay epoch 2's first 2 steps
+    c = _net()
+    c.set_listeners()
+    _, ran = fit_with_checkpointing(c, fresh_it(), epochs=3,
+                                    checkpoint_dir=d,
+                                    every_n_iterations=2, keep_last=10)
+    assert ran == 2                      # partial epoch 2 + epoch 3
+    assert c.iteration_count == 12       # 3 epochs x 4 batches, no replay
+    np.testing.assert_array_equal(_params(a), _params(c))
+
+
+def test_old_boundary_checkpoints_still_load(tmp_path):
+    """A checkpoint without the new manifest keys (pre-mid-epoch format)
+    is treated as an epoch-boundary save."""
+    from deeplearning4j_tpu.util.serialization import write_model
+    d = str(tmp_path)
+    net = _net()
+    net.iteration_count = 8              # 2 epochs x 4 batches
+    write_model(net, os.path.join(d, "checkpoint_epoch2.zip"))
+    it, _, _ = _it()
+    b = _net()
+    _, ran = fit_with_checkpointing(b, it, epochs=3, checkpoint_dir=d)
+    assert ran == 1                      # resumes at the epoch boundary
+    assert latest_checkpoint(d).endswith("epoch3.zip")
+
+
+def test_prune_only_touches_checkpoints_older_than_last_completed(tmp_path):
+    """Bugfix regression: pruning must only delete checkpoints strictly
+    older than the last write THIS listener completed — a newer file
+    (another process / an async writer mid-sequence) is neither counted
+    against keep_last nor deleted."""
+    d = str(tmp_path)
+    for name in ("checkpoint_epoch1.zip", "checkpoint_epoch2.zip",
+                 "checkpoint_epoch3.zip", "checkpoint_epoch3_step2.zip"):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"x")
+    lst = CheckpointListener(d, keep_last=1)
+    # before any completed write, prune is a no-op (it used to count the
+    # foreign files and delete all but one)
+    lst._prune()
+    assert len(list_checkpoints(d)) == 4
+    # we completed epoch 2: epoch 1 goes, epoch 2 is kept (keep_last=1),
+    # the NEWER epoch-3 files (another writer's) are untouched
+    lst._last_completed = (2, 0)
+    lst._prune()
+    names = sorted(os.path.basename(p) for p, _ in list_checkpoints(d))
+    assert names == ["checkpoint_epoch2.zip", "checkpoint_epoch3.zip",
+                     "checkpoint_epoch3_step2.zip"]
+
+
+def test_mid_epoch_checkpoints_prune_with_boundaries(tmp_path):
+    """Mixed boundary + mid-epoch saves order by (epoch, step) and prune
+    oldest-first under keep_last."""
+    net = _net()
+    it, _, _ = _it()
+    net.set_listeners(CheckpointListener(str(tmp_path), keep_last=3,
+                                         every_n_iterations=2))
+    # 2 epochs x 4 batches -> writes (0,2) (0,4) (1,0) (1,2) (1,4) (2,0);
+    # keep_last=3 leaves the newest three in (epoch, step) order
+    net.fit(iterator=it, epochs=2)
+    names = sorted(os.path.basename(p) for p, _ in
+                   list_checkpoints(str(tmp_path)))
+    assert names == ["checkpoint_epoch1_step2.zip",
+                     "checkpoint_epoch1_step4.zip",
+                     "checkpoint_epoch2.zip"]
 
 
 def test_profiler_listener_writes_trace(tmp_path):

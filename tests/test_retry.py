@@ -1,0 +1,126 @@
+"""util/retry: capped exponential backoff — deterministic mode, timeout
+budget, give-up contract (the shared policy behind the elastic
+coordinator and serving /reload checkpoint loads)."""
+import zipfile
+
+import pytest
+
+from deeplearning4j_tpu.util.retry import RetryError, RetryPolicy, retry_call
+
+
+def _flaky(n_failures, exc=OSError):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            raise exc(f"flake #{calls['n']}")
+        return calls["n"]
+    fn.calls = calls
+    return fn
+
+
+def test_deterministic_delays_are_capped():
+    p = RetryPolicy(max_attempts=6, base_delay_s=0.1, max_delay_s=0.5,
+                    multiplier=2.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_jitter_is_seeded_and_reproducible():
+    a = RetryPolicy(max_attempts=5, base_delay_s=0.1, jitter=0.5, seed=7)
+    b = RetryPolicy(max_attempts=5, base_delay_s=0.1, jitter=0.5, seed=7)
+    da, db = list(a.delays()), list(b.delays())
+    assert da == db
+    # jittered delays land in [1-jitter, 1] x nominal
+    for d, nominal in zip(da, [0.1, 0.2, 0.4, 0.8]):
+        assert 0.5 * nominal <= d <= nominal
+
+
+def test_success_after_transient_failures():
+    sleeps = []
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.1, sleep=sleeps.append)
+    fn = _flaky(2)
+    retries = []
+    assert p.call(fn, on_retry=lambda i, e: retries.append(str(e))) == 3
+    assert fn.calls["n"] == 3
+    assert sleeps == [0.1, 0.2]          # no real sleeping, injected
+    assert retries == ["flake #1", "flake #2"]
+
+
+def test_give_up_raises_retry_error_with_chained_cause():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.01, sleep=lambda s: None)
+    fn = _flaky(99)
+    with pytest.raises(RetryError) as ei:
+        p.call(fn)
+    assert ei.value.attempts == 3
+    assert fn.calls["n"] == 3
+    assert isinstance(ei.value.last, OSError)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_timeout_budget_gives_up_without_terminal_sleep():
+    """A retry whose sleep would cross timeout_s gives up immediately —
+    no pointless sleep followed by a doomed attempt."""
+    t = {"now": 0.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        t["now"] += s
+
+    p = RetryPolicy(max_attempts=10, base_delay_s=1.0, max_delay_s=1.0,
+                    timeout_s=2.5, sleep=sleep, clock=lambda: t["now"])
+    fn = _flaky(99)
+    with pytest.raises(RetryError, match="time budget"):
+        p.call(fn)
+    # attempt(t=0) -> sleep 1 -> attempt(t=1) -> sleep 1 -> attempt(t=2)
+    # -> next sleep would end at t=3 > 2.5 -> give up NOW
+    assert fn.calls["n"] == 3
+    assert sleeps == [1.0, 1.0]
+
+
+def test_non_retryable_propagates_untouched():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                    sleep=lambda s: None,
+                    retryable=lambda e: isinstance(e, OSError)
+                    and not isinstance(e, FileNotFoundError))
+    fn = _flaky(99, exc=FileNotFoundError)
+    with pytest.raises(FileNotFoundError):
+        p.call(fn)
+    assert fn.calls["n"] == 1            # no retries burned
+
+
+def test_retry_call_convenience():
+    assert retry_call(_flaky(1), policy=RetryPolicy(
+        max_attempts=2, sleep=lambda s: None)) == 2
+
+
+def test_reload_policy_shape():
+    """The serving /reload policy retries transient I/O but not a missing
+    path (FileNotFoundError must stay a fast 400)."""
+    from deeplearning4j_tpu.serving.http import _RELOAD_RETRY
+    assert _RELOAD_RETRY.retryable(OSError("nfs hiccup"))
+    assert _RELOAD_RETRY.retryable(zipfile.BadZipFile("landing"))
+    assert not _RELOAD_RETRY.retryable(FileNotFoundError("gone"))
+    assert not _RELOAD_RETRY.retryable(ValueError("not a model"))
+
+
+def test_reload_retries_transient_load_failure(monkeypatch, tmp_path):
+    """End-to-end: a load_net that flakes once succeeds on retry through
+    the /reload path's policy (unit-level — the HTTP harness is covered
+    by test_serving_engine)."""
+    from deeplearning4j_tpu.serving import registry as sreg
+    from deeplearning4j_tpu.serving.http import _RELOAD_RETRY
+
+    calls = {"n": 0}
+
+    def flaky_load(path):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient read error")
+        return "net"
+
+    monkeypatch.setattr(_RELOAD_RETRY, "_sleep", lambda s: None)
+    monkeypatch.setattr(sreg, "load_net", flaky_load)
+    assert _RELOAD_RETRY.call(sreg.load_net, str(tmp_path / "m.zip")) == "net"
+    assert calls["n"] == 2

@@ -1601,6 +1601,159 @@ print(json.dumps(out))
     return json.loads(lines[-1])
 
 
+def bench_zero_sharded_update(meshes=(4, 8), total_elems=400_000,
+                              bucket_bytes=256 * 1024, timeout=420,
+                              repeats=11):
+    """ZeRO-style sharded weight update (parallel/zero.py) vs the
+    replicated update, at mesh 4 and 8 on the virtual-CPU mesh, on a
+    ResNet-50-shaped leaf distribution with Adam state (the 2x-params
+    duplication the sharding removes).
+
+    Per mesh: interleaved medians of the full sync+update phase
+    (gradient combine -> updater -> params available replicated again),
+    three variants compiled up front — ``replicated`` (bucketed pmean +
+    per-leaf Adam on full state), ``zero1`` (bucketed all-reduce, shard
+    update, all-gather) and ``zero2`` (reduce-scatter, shard update,
+    all-gather) — plus the per-replica updater-state BYTES each variant
+    allocates: ``state_reduction`` =~ mesh size is the acceptance number
+    (padding costs a few %). Update-phase wall times on shared-core CPU
+    replicas measure launch/pack overhead only — the memory win is the
+    point, and real ICI is where reduce-scatter's halved bytes show.
+    Runs in a subprocess so the CPU platform doesn't poison this
+    process."""
+    code = r"""
+import json, time, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_map
+from deeplearning4j_tpu.parallel.overlap import (build_bucket_schedule,
+                                                 bucketed_pmean)
+from deeplearning4j_tpu.parallel.zero import ZeroUpdateEngine
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+MESHES = %(meshes)r
+TOTAL = %(total)d
+BUCKET = %(bucket)d
+REPEATS = %(repeats)d
+
+# ResNet-50-shaped leaf distribution scaled to TOTAL elements (same
+# recipe as the collective_overlap row: a few big kernels, many small
+# BN/bias vectors). Small TOTALs (the tier-1 smoke) thin the leaf
+# COUNT too — compile time is leaf-bound and the smoke pins structure,
+# not the full-scale distribution.
+div = 4 if TOTAL <= 150_000 else 1
+base = []
+for f_in, f_out, k, n in [(64, 64, 1, 6), (64, 64, 3, 6), (256, 128, 1, 8),
+                          (128, 128, 3, 8), (512, 256, 1, 12),
+                          (256, 256, 3, 12), (1024, 512, 1, 6),
+                          (512, 512, 3, 6)]:
+    base += [f_in * f_out * k * k] * max(1, n // div)
+base += [2048 * 1000]
+base += [s for v in (64, 256, 512, 1024, 2048) for s in [v] * (20 // div)]
+scale = TOTAL / float(sum(base))
+sizes = [max(8, int(s * scale)) for s in base]
+rng = np.random.default_rng(0)
+params = tuple(jnp.asarray(rng.normal(size=(s,)).astype(np.float32))
+               for s in sizes)
+grads = tuple(jnp.asarray(rng.normal(size=(s,)).astype(np.float32) * 1e-2)
+              for s in sizes)
+rule = Adam(1e-3)
+schedule = build_bucket_schedule(params, BUCKET)
+
+out = {"leaves": len(sizes),
+       "total_mb": round(sum(sizes) * 4 / 1e6, 2)}
+for ndev in MESHES:
+    mesh = make_mesh((ndev,), ("data",), devices=jax.devices()[:ndev])
+    eng = {st: ZeroUpdateEngine(params, [rule] * len(sizes),
+                                [1.0] * len(sizes), n_shards=ndev,
+                                stage=st, bucket_bytes=BUCKET, mesh=mesh)
+           for st in (1, 2)}
+    it = jnp.asarray(0, jnp.int32)
+
+    def repl(ps, gs, ms, vs, it):
+        gs = bucketed_pmean(tuple(gs), schedule, "data")
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(ps, gs, ms, vs):
+            upd, ns = rule.update_one(g, {"m": m, "v": v},
+                                      rule.lr(it), it)
+            new_p.append(p - upd)
+            new_m.append(ns["m"]); new_v.append(ns["v"])
+        return tuple(new_p), tuple(new_m), tuple(new_v)
+
+    def zero_fn(e):
+        def f(ps, gs, opt, it):
+            shards = e.grad_sync(tuple(gs))
+            new_p, new_opt = e.update(shards, opt, tuple(ps), it)
+            return tuple(new_p), new_opt
+        return f
+
+    rep, dsh = P(), P("data")
+    n_l = len(sizes)
+    j_repl = jax.jit(shard_map(
+        repl, mesh=mesh,
+        in_specs=((rep,) * n_l, (rep,) * n_l, (rep,) * n_l, (rep,) * n_l,
+                  rep),
+        out_specs=((rep,) * n_l, (rep,) * n_l, (rep,) * n_l),
+        check_vma=False))
+    zeros = tuple(jnp.zeros_like(p) for p in params)
+    compiled = {"replicated":
+                (lambda: j_repl(params, grads, zeros, zeros, it))}
+    for st in (1, 2):
+        e = eng[st]
+        opt = e.init_opt_state()
+        jz = jax.jit(shard_map(
+            zero_fn(e), mesh=mesh,
+            in_specs=((rep,) * n_l, (rep,) * n_l, dsh, rep),
+            out_specs=((rep,) * n_l, dsh), check_vma=False))
+        compiled["zero%%d" %% st] = (lambda jz=jz, opt=opt:
+                                     jz(params, grads, opt, it))
+    for fn in compiled.values():
+        jax.block_until_ready(fn())       # compile + warm
+    times = {name: [] for name in compiled}
+    for _ in range(REPEATS):
+        for name, fn in compiled.items():
+            t0 = time.perf_counter()
+            for _ in range(3):
+                r = fn()
+            jax.block_until_ready(r)
+            times[name].append((time.perf_counter() - t0) / 3)
+    row = {name + "_update_ms": round(float(np.median(ts)) * 1e3, 3)
+           for name, ts in times.items()}
+    row["state_bytes_replicated"] = eng[2].replicated_state_bytes
+    row["state_bytes_zero"] = eng[2].shard_state_bytes
+    row["state_reduction"] = round(
+        eng[2].replicated_state_bytes / max(1, eng[2].shard_state_bytes), 3)
+    row["reduce_launches"] = eng[2].num_reduce_launches
+    row["gather_launches"] = len(eng[2].groups)
+    out[str(ndev)] = row
+out["note"] = ("virtual CPU devices: replicated = bucketed pmean + "
+               "per-leaf Adam on full 2x-params state; zero1/zero2 = "
+               "sharded flat update (all-reduce+slice / reduce-scatter), "
+               "shard-sized state, params all-gathered; "
+               "state_reduction =~ mesh size is the memory win, "
+               "interleaved medians of 11x3 update phases; halved "
+               "reduce-scatter bytes need real ICI to show as time")
+print(json.dumps(out))
+""" % {"meshes": tuple(meshes), "total": int(total_elems),
+       "bucket": int(bucket_bytes), "repeats": int(repeats)}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=timeout, env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(f"zero-sharded-update subprocess failed "
+                           f"(rc={out.returncode}): "
+                           f"{out.stderr.strip()[-500:]}")
+    return json.loads(lines[-1])
+
+
 def bench_collective_overhead():
     """Collective-overhead breakdown per mesh shape on VIRTUAL CPU devices
     (BASELINE #5 — real chips unavailable, so chip-scaling efficiency is
@@ -2000,6 +2153,7 @@ def main():
             ("generate_tokens_per_sec", bench_generate),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overlap", bench_collective_overlap),
+            ("zero_sharded_update", bench_zero_sharded_update),
             ("collective_overhead_by_mesh", bench_collective_overhead),
             ("resnet50_amp_img_per_sec", _amp_ours),
             ("resnet50_piped_img_per_sec", _piped),
@@ -2025,7 +2179,8 @@ def main():
         # The collective row manages its own 420s subprocess timeout.
         # the collective rows manage their own subprocess timeouts
         cap = 460.0 if name in ("collective_overhead_by_mesh",
-                                "collective_overlap") else \
+                                "collective_overlap",
+                                "zero_sharded_update") else \
             min(row_cap, budget - elapsed + 60.0)
         signal.setitimer(signal.ITIMER_REAL, cap)
         try:

@@ -30,7 +30,7 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 
 def train_step_math(net, params, state, opt_state, it, rng, x, y,
-                    lmask=None, fmask=None, grad_sync=None):
+                    lmask=None, fmask=None, grad_sync=None, update_fn=None):
     """THE single-step update: loss+grads -> updater -> new carry. Every
     SGD-path program — Solver per-step and scan-window, ParallelWrapper
     sync per-step and sync window — traces exactly this function, so the
@@ -40,16 +40,22 @@ def train_step_math(net, params, state, opt_state, it, rng, x, y,
     ``grad_sync``: optional cross-worker combine applied to the raw grad
     pytree between backward and updater (ParallelWrapper's bucketed
     overlap path passes ``overlap.bucketed_pmean`` with its schedule
-    here, under shard_map). The seam lives in THIS function so the fused
-    scan window carries the same bucket schedule as the per-step path —
-    structurally, not by convention."""
+    here, under shard_map; the ZeRO path passes its reduce-scatter).
+    ``update_fn``: optional replacement for ``net.updater.update`` with
+    the same ``(grads, opt_state, params, it) -> (params, opt_state)``
+    signature — the ZeRO engine's sharded update plugs in here, and
+    receives whatever ``grad_sync`` produced (the full tree, or its
+    local gradient shards). Both seams live in THIS function so the
+    fused scan window carries the same sync + update structure as the
+    per-step path — structurally, not by convention."""
     def lf(p):
         return net.loss_fn(p, state, x, y, train=True, rng=rng,
                            labels_mask=lmask, features_mask=fmask)
     (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
     if grad_sync is not None:
         grads = grad_sync(grads)
-    new_params, new_opt = net.updater.update(grads, opt_state, params, it)
+    update = net.updater.update if update_fn is None else update_fn
+    new_params, new_opt = update(grads, opt_state, params, it)
     return new_params, new_state, new_opt, loss
 
 

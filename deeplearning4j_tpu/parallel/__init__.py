@@ -3,6 +3,7 @@ from .data_parallel import ParallelWrapper
 from .inference import ParallelInference
 from .overlap import (BucketSchedule, GradBucket, build_bucket_schedule,
                       bucketed_pmean, fused_pmean, profile_schedule)
+from .zero import ZeroUpdateEngine, is_zero_state, make_zero_resharder
 from .elastic import ElasticTrainer, RecoveryFailedError
 from .faults import (CoordinationError, CoordinationFlake, CorruptCheckpoint,
                      FaultInjector, FaultPlan, KillWorker, PreemptAt,
@@ -12,6 +13,7 @@ __all__ = ["data_sharding", "make_mesh", "replicated", "window_sharding",
            "ParallelWrapper", "ParallelInference",
            "BucketSchedule", "GradBucket", "build_bucket_schedule",
            "bucketed_pmean", "fused_pmean", "profile_schedule",
+           "ZeroUpdateEngine", "is_zero_state", "make_zero_resharder",
            "ElasticTrainer", "RecoveryFailedError",
            "FaultInjector", "FaultPlan", "KillWorker", "SlowCollective",
            "CorruptCheckpoint", "PreemptAt", "CoordinationFlake",

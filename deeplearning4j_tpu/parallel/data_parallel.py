@@ -43,6 +43,7 @@ from .mesh import (data_sharding, make_mesh, replicated, shard_map,
                    window_sharding)
 from .overlap import (DEFAULT_BUCKET_BYTES, build_bucket_schedule,
                       bucketed_pmean, fused_pmean)
+from .zero import ZeroUpdateEngine, is_zero_state
 
 
 class ParallelWrapper:
@@ -80,10 +81,22 @@ class ParallelWrapper:
     schedule). Bit-identical to the unbucketed path at every bucket
     size (tests/test_overlap_sync.py).
 
-    On every sync path (plain and overlap), a batch whose size does not
-    tile the mesh — the end-of-epoch remainder the prefetcher ships
-    unsharded — dispatches through a replicated-feed program for that
-    step instead of raising the divisibility error; the update is
+    ``zero_stage=1|2`` (sync path): ZeRO-style cross-replica sharding of
+    the weight update (parallel/zero.py, arXiv 2004.13336). Each replica
+    applies the updater to only its 1/N flat shard of the grad+param
+    tree — updater state is allocated SHARD-SIZED (``net.opt_state``
+    becomes the engine's sharded format for the duration; convert back
+    with ``gather_opt_state()``) — then all-gathers the updated params.
+    Stage 1 all-reduces grads per bucket (the same collectives as
+    ``overlap_sync``) and slices; stage 2 reduce-scatters per bucket
+    (half the collective bytes). Both are bit-identical to the
+    replicated update and compose with ``steps_per_dispatch`` windows
+    and the remainder fallback (tests/test_zero.py).
+
+    On every sync path (plain, overlap and zero), a batch whose size
+    does not tile the mesh — the end-of-epoch remainder the prefetcher
+    ships unsharded — dispatches through a replicated-feed program for
+    that step instead of raising the divisibility error; the update is
     identical. The explicit-accumulator path keeps the loud error (its
     per-worker carry has no replicated equivalent).
     """
@@ -95,6 +108,7 @@ class ParallelWrapper:
                  gradient_accumulator=None, steps_per_dispatch: int = 1,
                  overlap_sync: bool = False,
                  bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 zero_stage: int = 0,
                  step_callback=None):
         self.net = net
         devices = jax.devices()
@@ -146,6 +160,34 @@ class ParallelWrapper:
         self.overlap_sync = overlap_sync
         self.bucket_bytes = bucket_bytes
         self._bucket_schedule = None     # built lazily from net.params
+        # ZeRO sharded update (parallel/zero.py): stage 1 = shard the
+        # updater state (grads still all-reduced, bucketed), stage 2 =
+        # reduce-scatter the grads too. Sync-path only: the K-step
+        # averaging path pmeans whole param/state trees (sharded state
+        # has no per-worker trajectory to average) and the accumulator
+        # owns its own combine.
+        if zero_stage not in (0, 1, 2):
+            raise ValueError(f"zero_stage must be 0, 1 or 2, "
+                             f"got {zero_stage}")
+        if zero_stage and gradient_accumulator is not None:
+            raise ValueError(
+                "zero_stage shards the plain sync update; a "
+                "GradientsAccumulator owns its own combine — pick one")
+        if zero_stage and self.training_mode == "averaging" \
+                and self.averaging_frequency > 1:
+            raise ValueError(
+                "zero_stage applies to the per-step sync all-reduce "
+                "path; the K-step averaging path averages full "
+                "per-worker param/state trajectories, which a sharded "
+                "updater state cannot represent")
+        if zero_stage and overlap_sync:
+            raise ValueError(
+                "zero_stage already dispatches per-bucket overlapped "
+                "collectives (stage 1 is the overlap_sync launch "
+                "pattern; stage 2 reduce-scatters the same buckets) — "
+                "drop overlap_sync rather than have it silently ignored")
+        self.zero_stage = zero_stage
+        self._zero_engine = None         # built lazily from net.params
         self.steps_per_dispatch = steps_per_dispatch
         self._acc_state = None
         self._sync_step = None
@@ -291,6 +333,85 @@ class ParallelWrapper:
                        out_specs=(rep, rep, rep, rep), check_vma=False)
         return jax.jit(fn, donate_argnums=(0, 2))
 
+    # --------------------------------------------------- zero sharded path
+    def _zero(self) -> ZeroUpdateEngine:
+        """The ZeRO engine for this net+mesh (layout built once on host;
+        rebuilding only matters when the param structure changes)."""
+        if self._zero_engine is None:
+            self._zero_engine = ZeroUpdateEngine.from_net(
+                self.net, self.mesh, stage=self.zero_stage,
+                bucket_bytes=self.bucket_bytes)
+        return self._zero_engine
+
+    def gather_opt_state(self):
+        """Convert ``net.opt_state`` back to the replicated per-leaf
+        format (all-gather on host) — for serialization or for handing
+        the net to a non-zero training path. No-op if already
+        replicated."""
+        if is_zero_state(self.net.opt_state):
+            self.net.opt_state = self._zero().unshard_opt_state(
+                self.net.opt_state)
+        return self.net.opt_state
+
+    def _build_zero_step(self, replicated_feed: bool = False):
+        """Sharded-update sync DP (parallel/zero.py): grads combined via
+        the engine's grad_sync (stage 1: bucketed all-reduce — the same
+        launches as the overlap path; stage 2: per-bucket reduce-scatter
+        at half the bytes), the updater applied to THIS worker's 1/N
+        flat shard only (opt state enters [N, L] sharded on the data
+        axis and stays sharded), updated params all-gathered back to
+        replicated. State and loss ride ONE fused variadic pmean."""
+        net = self.net
+        mesh = self.mesh
+        eng = self._zero()
+
+        def worker_step(params, state, opt_state, it, rng, x, y):
+            new_params, new_state, new_opt, loss = train_step_math(
+                net, params, state, opt_state, it, rng, x, y,
+                grad_sync=eng.grad_sync, update_fn=eng.update)
+            new_state, loss = fused_pmean((new_state, loss), "data")
+            return new_params, new_state, new_opt, loss
+
+        rep = P()
+        osh = P("data")                      # [N, L] state shards
+        dsh = rep if replicated_feed else P("data")
+        fn = shard_map(worker_step, mesh=mesh,
+                       in_specs=(rep, rep, osh, rep, rep, dsh, dsh),
+                       out_specs=(rep, rep, osh, rep), check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2))
+
+    def _build_zero_window_step(self, replicated_feed: bool = False):
+        """K fused zero-sharded steps in ONE lax.scan program: the scan
+        body is ``train_step_math`` with the SAME engine seams as
+        ``_build_zero_step`` (grad_sync + update_fn ride the body
+        structurally), opt-state shards in the donated carry — K fused
+        steps stay bit-identical to K per-step zero dispatches."""
+        net = self.net
+        mesh = self.mesh
+        eng = self._zero()
+
+        def window_step(params, state, opt_state, it0, base_rng, xs, ys):
+            def body(carry, inp):
+                params, state, opt_state, it = carry
+                x, y = inp
+                rng = jax.random.fold_in(base_rng, it)
+                new_params, new_state, new_opt, loss = train_step_math(
+                    net, params, state, opt_state, it, rng, x, y,
+                    grad_sync=eng.grad_sync, update_fn=eng.update)
+                new_state, loss = fused_pmean((new_state, loss), "data")
+                return (new_params, new_state, new_opt, it + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, it0), (xs, ys))
+            return params, state, opt_state, losses
+
+        rep, osh = P(), P("data")
+        wsh = rep if replicated_feed else P(None, "data")
+        fn = shard_map(window_step, mesh=mesh,
+                       in_specs=(rep, rep, osh, rep, rep, wsh, wsh),
+                       out_specs=(rep, rep, osh, rep), check_vma=False)
+        return jax.jit(fn, donate_argnums=(0, 2))
+
     def _remainder_step_fn(self):
         """The sync step with x/y REPLICATED: serves batches whose size
         does not tile the mesh — shard_map (overlap path) and
@@ -301,18 +422,26 @@ class ParallelWrapper:
         dispatch would produce (GSPMD's psum over per-shard partials IS
         the full-batch reduction), matching the contract of the
         prefetcher shipping remainders unsharded and iter_windows
-        dropping ragged groups to per-step."""
+        dropping ragged groups to per-step. The zero path keeps its
+        sharded update under the replicated feed (every device computes
+        the full-batch grads, the reduce is then a no-op-by-value, the
+        shard update and all-gather run as usual)."""
         if self._remainder_step is None:
-            self._remainder_step = self._build_sync_step(
-                feed_sharding=replicated(self.mesh))
+            self._remainder_step = (
+                self._build_zero_step(replicated_feed=True)
+                if self.zero_stage else
+                self._build_sync_step(feed_sharding=replicated(self.mesh)))
         return self._remainder_step
 
     def _remainder_window_step_fn(self):
         """Window variant of ``_remainder_step_fn`` (uniformly
         non-divisible batch sizes stack into regular windows too)."""
         if self._remainder_window_step is None:
-            self._remainder_window_step = self._build_sync_window_step(
-                feed_sharding=replicated(self.mesh))
+            self._remainder_window_step = (
+                self._build_zero_window_step(replicated_feed=True)
+                if self.zero_stage else
+                self._build_sync_window_step(
+                    feed_sharding=replicated(self.mesh)))
         return self._remainder_window_step
 
     # ------------------------------------------------------ accumulator path
@@ -420,9 +549,17 @@ class ParallelWrapper:
         if net.params is None:
             net.init()
         sync = self.training_mode == "shared_gradients" or self.averaging_frequency == 1
+        if sync and self.zero_stage:
+            # the engine owns the opt-state format: shard a replicated
+            # tree on first entry (pure redistribution), validate an
+            # already-sharded one against THIS mesh's layout
+            self.net.opt_state = self._zero().shard_opt_state(
+                self.net.opt_state)
         if sync and self._sync_step is None:
             if self.gradient_accumulator is not None:
                 self._sync_step = self._build_accum_step()
+            elif self.zero_stage:
+                self._sync_step = self._build_zero_step()
             elif self.overlap_sync:
                 self._sync_step = self._build_overlap_step()
             else:
@@ -501,11 +638,18 @@ class ParallelWrapper:
             # per epoch, one locked int add per iteration
             _c_iters = reg.counter("train.iterations")
             _c_windows = reg.counter("train.windows")
-            # host-side collective accounting on the overlap path: grad
-            # buckets + the fused state/loss launch, per executed step
+            # host-side collective accounting on the overlap/zero paths:
+            # grad reduce launches (+ param all-gathers on zero) + the
+            # fused state/loss launch, per executed step
             _c_coll = reg.counter("parallel.collective_launches")
-            _n_buckets = len(self._grad_schedule()) if self.overlap_sync else 0
-            _n_coll = (_n_buckets + 1) if self.overlap_sync else 0
+            if self.zero_stage:
+                _n_buckets = self._zero().num_reduce_launches
+                _n_coll = self._zero().collectives_per_step + 1
+            elif self.overlap_sync:
+                _n_buckets = len(self._grad_schedule())
+                _n_coll = _n_buckets + 1
+            else:
+                _n_buckets = _n_coll = 0
             windowed = (self.steps_per_dispatch > 1
                         and self.gradient_accumulator is None)
             stream = (iter_windows(src, self.steps_per_dispatch)
@@ -519,6 +663,8 @@ class ParallelWrapper:
                 if isinstance(item, BatchWindow):
                     if self._sync_window_step is None:
                         self._sync_window_step = (
+                            self._build_zero_window_step()
+                            if self.zero_stage else
                             self._build_overlap_window_step()
                             if self.overlap_sync
                             else self._build_sync_window_step())
@@ -531,8 +677,9 @@ class ParallelWrapper:
                             # batch size doesn't tile the mesh: dispatch
                             # the replicated window program (identical
                             # update) instead of the divisibility error
+                            # (the zero remainder keeps its collectives)
                             wstep = self._remainder_window_step_fn()
-                            n_coll = 0
+                            n_coll = _n_coll if self.zero_stage else 0
                         with span("dispatch", k=k, buckets=_n_buckets):
                             (net.params, net.state, net.opt_state,
                              losses) = wstep(
@@ -575,9 +722,10 @@ class ParallelWrapper:
                     else:
                         step = self._sync_step
                         if x.shape[0] % self.n != 0:
-                            # remainder batch: replicated fallback
+                            # remainder batch: replicated fallback (the
+                            # zero remainder keeps its collectives)
                             step = self._remainder_step_fn()
-                            n_coll = 0
+                            n_coll = _n_coll if self.zero_stage else 0
                         net.params, net.state, net.opt_state, loss = \
                             step(net.params, net.state,
                                  net.opt_state, it, rng, x, y)

@@ -54,6 +54,8 @@ from ..util.retry import RetryError, RetryPolicy
 from .data_parallel import ParallelWrapper
 from .faults import CoordinationError, FaultInjector, WorkerLostError
 from .mesh import make_mesh, replicated
+from .overlap import DEFAULT_BUCKET_BYTES
+from .zero import ZeroUpdateEngine, make_zero_resharder
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -124,8 +126,26 @@ class ElasticTrainer:
                  degraded_exit_patience: int = 2,
                  final_checkpoint: bool = True,
                  fault_injector: Optional[FaultInjector] = None,
+                 zero_stage: int = 0,
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                  registry=None):
         self.net = net
+        # ZeRO sharded update (parallel/zero.py): the supervised sync
+        # loop runs ParallelWrapper(zero_stage=...); the sharded updater
+        # state flows through the async checkpoint writer with its
+        # shard-layout block in the manifest, and a mesh that shrinks
+        # after worker loss RE-SHARDS the state on restore (all-gather ->
+        # re-slice) instead of aborting. The SparkNet degraded mode
+        # averages full per-worker state trajectories, which sharded
+        # state cannot represent — refuse the combination loudly.
+        if zero_stage and sync_latency_budget_ms is not None:
+            raise ValueError(
+                "zero_stage does not compose with the degraded "
+                "averaging-window mode (sync_latency_budget_ms): "
+                "averaging needs full per-worker updater state")
+        self.zero_stage = zero_stage
+        self.bucket_bytes = bucket_bytes
+        self._engines = {}               # mesh-size -> ZeroUpdateEngine
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_n_steps = checkpoint_every_n_steps
         self.keep_last = keep_last
@@ -188,7 +208,15 @@ class ElasticTrainer:
                     self.net, mesh=self._mesh,
                     steps_per_dispatch=self.steps_per_dispatch,
                     prefetch_buffer=self.prefetch_buffer,
+                    zero_stage=self.zero_stage,
+                    bucket_bytes=self.bucket_bytes,
                     step_callback=self._on_item)
+                if self.zero_stage:
+                    # ONE engine per mesh: the wrapper reuses the
+                    # trainer's (same net/stage/bucket_bytes by
+                    # construction), so the layout is built once and
+                    # sharding_meta/resharder/step programs cannot drift
+                    pw._zero_engine = self._engine_for(self._mesh)
             else:       # degraded: SparkNet-style infrequent-sync windows
                 pw = ParallelWrapper(
                     self.net, mesh=self._mesh, training_mode="averaging",
@@ -204,16 +232,45 @@ class ElasticTrainer:
         return {"params": net.params, "state": net.state,
                 "opt": net.opt_state}
 
+    def _engine_for(self, mesh) -> ZeroUpdateEngine:
+        """The ZeRO layout for ``mesh`` (cached per device set — the
+        layout is host metadata, but the init/like state it builds must
+        carry the right mesh's shardings)."""
+        key = (mesh.devices.size, tuple(d.id for d in mesh.devices.flat))
+        eng = self._engines.get(key)
+        if eng is None:
+            eng = self._engines[key] = ZeroUpdateEngine.from_net(
+                self.net, mesh, stage=self.zero_stage,
+                bucket_bytes=self.bucket_bytes)
+        return eng
+
+    def _sharding_meta(self) -> Optional[dict]:
+        return (self._engine_for(self._mesh).sharding_meta()
+                if self.zero_stage else None)
+
+    def _resharder(self, mesh):
+        """Restore hook: zero-sharded updater state saved on a different
+        mesh size re-shards (all-gather -> re-slice) onto ``mesh``
+        instead of failing the restore."""
+        return (make_zero_resharder(self._engine_for(mesh))
+                if self.zero_stage else None)
+
     def _like_tree(self, mesh) -> dict:
-        """Restore target: the current train state re-homed (replicated)
-        on ``mesh`` — supplies both the tree structure and the target
-        shardings for restore_sharded_checkpoint."""
+        """Restore target: the current train state re-homed on ``mesh``
+        (params/state replicated; zero updater state in the engine's
+        [N, L] data-axis-sharded layout for that mesh) — supplies both
+        the tree structure and the target shardings for
+        restore_sharded_checkpoint."""
         rep = replicated(mesh)
         put = lambda t: jax.tree.map(
             lambda a: jax.device_put(jnp.asarray(a), rep), t)
+        if self.zero_stage:
+            opt_like = self._engine_for(mesh).init_opt_state()
+        else:
+            opt_like = put(self.net.opt_state)
         return {"params": put(self.net.params),
                 "state": put(self.net.state),
-                "opt": put(self.net.opt_state)}
+                "opt": opt_like}
 
     # ------------------------------------------------------------- step hook
     def _step_in_epoch(self) -> int:
@@ -243,7 +300,8 @@ class ElasticTrainer:
         extra = {"step_in_epoch": self._step_in_epoch()}
         if self._epoch_len:
             extra["epoch_len"] = self._epoch_len
-        self._writer.submit(it, self._tree(), extra=extra)
+        self._writer.submit(it, self._tree(), extra=extra,
+                            sharding=self._sharding_meta())
 
     # ------------------------------------------------------- degraded mode
     def _update_latency(self, it: int, k: int) -> None:
@@ -330,7 +388,8 @@ class ElasticTrainer:
                 like = self._like_tree(mesh)
                 if self.checkpoint_dir is not None:
                     step, tree, extra = restore_latest_sharded_checkpoint(
-                        self.checkpoint_dir, like)
+                        self.checkpoint_dir, like,
+                        resharder=self._resharder(mesh))
                 else:
                     step, tree, extra = None, like, {}
                 return devices, mesh, step, tree, extra
@@ -353,6 +412,10 @@ class ElasticTrainer:
         self._devices = devices
         self._mesh = mesh
         self._wrappers = {}          # programs are per-mesh
+        # drop engines for dead meshes (the one just built for the new
+        # mesh — via _like_tree — stays cached)
+        keep = (mesh.devices.size, tuple(d.id for d in mesh.devices.flat))
+        self._engines = {k: v for k, v in self._engines.items() if k == keep}
         net = self.net
         if step is None:
             # nothing restorable: deterministic restart from scratch
@@ -396,7 +459,8 @@ class ElasticTrainer:
         if newest is None or newest <= self.net.iteration_count:
             return
         step, tree, extra = restore_latest_sharded_checkpoint(
-            self.checkpoint_dir, self._like_tree(self._mesh))
+            self.checkpoint_dir, self._like_tree(self._mesh),
+            resharder=self._resharder(self._mesh))
         # the actual restore may fall back to an OLDER save than the
         # probe saw (corrupt member only detectable on read)
         if step is None or step <= self.net.iteration_count:
@@ -496,7 +560,8 @@ class ElasticTrainer:
                             it, self._tree(),
                             extra={"step_in_epoch": self._step_in_epoch(),
                                    **({"epoch_len": self._epoch_len}
-                                      if self._epoch_len else {})})
+                                      if self._epoch_len else {})},
+                            sharding=self._sharding_meta())
                 finally:
                     writer.close()
         self.steps_done = net.iteration_count

@@ -79,7 +79,7 @@ class AsyncCheckpointWriter:
         self._ckpt = DistributedCheckpointer(directory, every_n_steps=1,
                                              keep_last=keep_last)
         self._lock = threading.Condition()
-        self._pending: Optional[tuple] = None    # (step, snapshot, extra)
+        self._pending: Optional[tuple] = None  # (step, snapshot, extra, sharding)
         self._writing: Optional[int] = None
         self._stop = False
         self.last_completed_step: Optional[int] = None
@@ -90,10 +90,13 @@ class AsyncCheckpointWriter:
 
     # ----------------------------------------------------------- train side
     def submit(self, step: int, tree: Any,
-               extra: Optional[Dict[str, Any]] = None) -> bool:
+               extra: Optional[Dict[str, Any]] = None,
+               sharding: Optional[Dict[str, Any]] = None) -> bool:
         """Snapshot ``tree`` and enqueue it for writing as ``step``.
         Returns False if it replaced (dropped) an older pending snapshot.
-        Never blocks on the device or the filesystem."""
+        Never blocks on the device or the filesystem. ``sharding`` is the
+        manifest layout block (the ZeRO engine's shard metadata) — plain
+        host JSON, stored with the snapshot."""
         snap = _snapshot(tree)
         fresh = True
         with self._lock:
@@ -103,7 +106,7 @@ class AsyncCheckpointWriter:
                 fresh = False
                 if self._reg.enabled:
                     self._reg.counter("elastic.checkpoint.dropped").inc()
-            self._pending = (step, snap, dict(extra or {}))
+            self._pending = (step, snap, dict(extra or {}), sharding)
             self._lock.notify_all()
         if self._reg.enabled:
             self._reg.counter("elastic.checkpoint.submitted").inc()
@@ -122,7 +125,8 @@ class AsyncCheckpointWriter:
         return True
 
     def save_sync(self, step: int, tree: Any,
-                  extra: Optional[Dict[str, Any]] = None) -> None:
+                  extra: Optional[Dict[str, Any]] = None,
+                  sharding: Optional[Dict[str, Any]] = None) -> None:
         """Blocking write on the CALLING thread (the preemption/final-flush
         path). Drains the async queue first so on-disk steps stay
         monotonic, skips the write if ``step`` already landed."""
@@ -130,7 +134,7 @@ class AsyncCheckpointWriter:
         if self.last_completed_step is not None \
                 and step <= self.last_completed_step:
             return
-        self._write(step, tree, dict(extra or {}))
+        self._write(step, tree, dict(extra or {}), sharding)
 
     def close(self, flush: bool = True) -> None:
         if flush:
@@ -153,22 +157,26 @@ class AsyncCheckpointWriter:
                     self._lock.wait()
                 if self._pending is None and self._stop:
                     return
-                step, snap, extra = self._pending
+                step, snap, extra, sharding = self._pending
                 self._pending = None
                 self._writing = step
             try:
-                self._write(step, snap, extra)
+                self._write(step, snap, extra, sharding)
             finally:
                 with self._lock:
                     self._writing = None
                     self._lock.notify_all()
 
-    def _write(self, step: int, tree: Any, extra: Dict[str, Any]) -> None:
+    def _write(self, step: int, tree: Any, extra: Dict[str, Any],
+               sharding: Optional[Dict[str, Any]] = None) -> None:
         t0 = time.perf_counter()
         try:
             with span("checkpoint_write", step=step):
+                # sharding passed only when present: the plain path keeps
+                # the historical call shape (and the manifest stays lean)
+                kw = {"sharding": sharding} if sharding is not None else {}
                 save_sharded_checkpoint(self.directory, step, tree,
-                                        extra=extra)
+                                        extra=extra, **kw)
                 if jax.process_index() == 0:
                     self._ckpt._prune()
         except BaseException as e:  # a sick disk must not kill training

@@ -49,7 +49,16 @@ class CheckpointListener(TrainingListener):
     concurrently (an async writer, another process sharing the
     directory) is newer than our last completed write and is therefore
     never counted against ``keep_last`` nor deleted under a reader that
-    just resolved it as "latest"."""
+    just resolved it as "latest".
+
+    Model-zip checkpoints store the REPLICATED per-leaf updater state; a
+    net training under ``ParallelWrapper(zero_stage=..)`` holds the
+    ZeRO-sharded format instead, which ``write_model`` refuses (the flat
+    layout would corrupt the zip's updater entry). Zero runs checkpoint
+    through the sharded-checkpoint path (``ElasticTrainer`` /
+    ``util.distributed_checkpoint``, whose manifests carry the shard
+    layout); use this listener with ``save_updater=False`` or after
+    ``gather_opt_state()`` otherwise."""
 
     def __init__(self, directory: str, every_n_epochs: int = 1,
                  keep_last: int = 3, save_updater: bool = True,

@@ -52,7 +52,8 @@ def _norm_index(index: Tuple[slice, ...], shape: Tuple[int, ...]):
 
 
 def save_sharded_checkpoint(directory: str, step: int, tree: Any,
-                            extra: Optional[Dict[str, Any]] = None) -> str:
+                            extra: Optional[Dict[str, Any]] = None,
+                            sharding: Optional[Dict[str, Any]] = None) -> str:
     """Write this process's shards of ``tree`` (any pytree of jax.Arrays —
     bundle params/opt_state/state/it as a dict) + the manifest. Returns the
     manifest path. In a multi-process run every process MUST call this (each
@@ -62,7 +63,14 @@ def save_sharded_checkpoint(directory: str, step: int, tree: Any,
     ``extra`` is a JSON-serializable dict stored verbatim in the manifest
     (read back via :func:`read_manifest`): the elastic trainer keeps its
     resume metadata there (``step_in_epoch``, ``epoch_len``) so a resumed
-    run can skip to the right position without replaying the epoch."""
+    run can skip to the right position without replaying the epoch.
+
+    ``sharding`` is a JSON-serializable layout-description block, also
+    stored verbatim: the ZeRO engine records its shard layout (axis, mesh
+    size, per-group bucketing) here so restore can VALIDATE the layout
+    against the current mesh and re-shard on mismatch instead of
+    mis-slicing state saved on a different topology (see
+    ``restore_latest_sharded_checkpoint``'s ``resharder``)."""
     os.makedirs(directory, exist_ok=True)
     leaves = jax.tree.leaves(tree)
     pidx = jax.process_index()
@@ -99,11 +107,14 @@ def save_sharded_checkpoint(directory: str, step: int, tree: Any,
         os.close(fd)
         try:
             with open(tmp, "w") as f:
-                json.dump({"step": step,
-                           "num_processes": jax.process_count(),
-                           "n_leaves": len(leaves),
-                           "leaves": meta_leaves,
-                           "extra": dict(extra or {})}, f)
+                payload_meta = {"step": step,
+                                "num_processes": jax.process_count(),
+                                "n_leaves": len(leaves),
+                                "leaves": meta_leaves,
+                                "extra": dict(extra or {})}
+                if sharding is not None:
+                    payload_meta["sharding"] = dict(sharding)
+                json.dump(payload_meta, f)
             os.replace(tmp, manifest)
         finally:
             if os.path.exists(tmp):
@@ -191,12 +202,23 @@ def latest_sharded_step(directory: str) -> Optional[int]:
     return None
 
 
-def restore_latest_sharded_checkpoint(directory: str, like: Any
+def restore_latest_sharded_checkpoint(directory: str, like: Any,
+                                      resharder=None
                                       ) -> Tuple[Optional[int], Any, dict]:
     """Restore the newest checkpoint that actually loads, walking backwards
     past incomplete, truncated, or corrupt saves instead of crashing on
     the newest entry. Returns ``(step, tree, extra)`` — or
     ``(None, like, {})`` when nothing in the directory is restorable.
+
+    ``resharder``: optional ``(directory, step, like, manifest) -> tree``
+    hook consulted when the candidate's manifest carries a ``sharding``
+    layout block (the ZeRO engine's shard-layout metadata). It may return
+    a re-sharded tree (state saved on a different mesh size re-sliced to
+    the current one — ``parallel.zero.make_zero_resharder``), or ``None``
+    to signal the layout already matches and the direct restore should
+    proceed. A resharder exception falls back to an older save like any
+    other restore failure, so a truncated or corrupt newest save never
+    blocks a re-shard recovery.
 
     This is the recovery entry point: after a preemption the newest save
     is exactly the one most likely to be damaged (the writer died
@@ -207,15 +229,73 @@ def restore_latest_sharded_checkpoint(directory: str, like: Any
             _log.warning("checkpoint step %d in %s is incomplete/truncated; "
                          "falling back to an older save", step, directory)
             continue
+        manifest = read_manifest(directory, step) or {}
         try:
-            tree = restore_sharded_checkpoint(directory, step, like)
+            tree = None
+            if resharder is not None and manifest.get("sharding"):
+                tree = resharder(directory, step, like, manifest)
+            if tree is None:
+                tree = restore_sharded_checkpoint(directory, step, like)
         except Exception as e:  # corrupt member, CRC, topology mismatch
             _log.warning("checkpoint step %d in %s failed to restore (%s); "
                          "falling back to an older save", step, directory, e)
             continue
-        manifest = read_manifest(directory, step) or {}
         return step, tree, dict(manifest.get("extra") or {})
     return None, like, {}
+
+
+def load_checkpoint_arrays(directory: str, step: int) -> List[np.ndarray]:
+    """Assemble every leaf of the checkpoint at ``step`` FULLY on host
+    (numpy), from the per-process shard blocks — the all-gather half of a
+    restore-time re-shard (arXiv 2112.01075: redistribution = gather +
+    re-slice). Needs every process's shard file visible (shared storage);
+    raises if any region of any leaf is uncovered, so a missing peer file
+    surfaces as a restore failure the caller can walk back from."""
+    with open(os.path.join(directory, f"ckpt_step{step}.json")) as f:
+        manifest = json.load(f)
+    files = _shard_files(directory, step)
+    if not files:
+        raise FileNotFoundError(f"no shard files for step {step} in "
+                                f"{directory!r}")
+    out: List[Optional[np.ndarray]] = [None] * manifest["n_leaves"]
+    covered = [0] * manifest["n_leaves"]
+    seen: List[set] = [set() for _ in range(manifest["n_leaves"])]
+    key_re = re.compile(r"^l(\d+)_s(\d+)_idx$")
+    for path in files:
+        with np.load(path) as z:
+            for key in z.files:
+                m = key_re.match(key)
+                if not m:
+                    continue
+                i = int(m.group(1))
+                meta = manifest["leaves"][i]
+                target = jax.numpy.dtype(meta["dtype"])
+                idx = tuple(tuple(int(v) for v in row) for row in z[key])
+                if idx in seen[i]:       # replicated duplicate
+                    continue
+                seen[i].add(idx)
+                block = z[key[:-4]]
+                block = (block.view(target) if block.dtype.kind == "V"
+                         else block.astype(target, copy=False))
+                if out[i] is None:
+                    out[i] = np.zeros(tuple(meta["shape"]), target)
+                sl = tuple(slice(a, b) for a, b in idx)
+                out[i][sl] = block
+                covered[i] += int(np.prod([b - a for a, b in idx],
+                                          dtype=np.int64)) if idx else 1
+    for i, meta in enumerate(manifest["leaves"]):
+        size = int(np.prod(meta["shape"], dtype=np.int64))
+        if out[i] is None and size:
+            raise ValueError(f"leaf {i}: no blocks found")
+        if out[i] is None:               # zero-size / scalar-less edge
+            out[i] = np.zeros(tuple(meta["shape"]),
+                              jax.numpy.dtype(meta["dtype"]))
+        if covered[i] < size:
+            raise ValueError(
+                f"leaf {i}: blocks cover {covered[i]} of {size} elements "
+                f"— shard file missing? (host assembly needs shared "
+                f"storage)")
+    return out
 
 
 def restore_sharded_checkpoint(directory: str, step: int, like: Any) -> Any:

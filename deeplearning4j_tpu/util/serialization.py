@@ -65,6 +65,16 @@ def write_model(net, path: str, save_updater: bool = True,
                     "iteration_count": getattr(net, "iteration_count", 0),
                     "has_updater": bool(save_updater and net.opt_state is not None)}
         if manifest["has_updater"]:
+            from ..parallel.zero import is_zero_state
+            if is_zero_state(net.opt_state):
+                # a ZeRO-sharded flat state would serialize with the wrong
+                # layout and silently corrupt the zip's updater entry —
+                # the wrapper can gather it back to the per-leaf format
+                raise ValueError(
+                    "net.opt_state is in the ZeRO sharded format; call "
+                    "ParallelWrapper.gather_opt_state() (or "
+                    "ZeroUpdateEngine.unshard_opt_state) before writing "
+                    "a model zip, or pass save_updater=False")
             upd_flat = _flatten_tree(net.opt_state).astype(np.float32)
             z.writestr(UPDATER_ENTRY, upd_flat.tobytes())
             manifest["n_updater_state"] = int(upd_flat.size)

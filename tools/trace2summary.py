@@ -69,15 +69,19 @@ def summarize(events: List[dict], by: str = "path") -> List[dict]:
             # a non-span event (e.g. a backend_compile attributed to the
             # span it happened under) gets its own bucket beneath that
             # span's path instead of inflating the span's numbers; a
-            # per-bucket collective event (cat=collective with a bucket
-            # attr, from parallel/overlap.profile_schedule) additionally
-            # keys on its bucket id so each bucket's all-reduce cost
-            # reads as its own phase
+            # collective event (cat=collective, from
+            # parallel/overlap.profile_schedule or the ZeRO engine's
+            # profile) additionally keys on its bucket id — and its group
+            # id when present — so each bucket_psum / reduce_scatter /
+            # all_gather launch's cost reads as its own phase
             if e.get("cat", "span") != "span":
                 label = name
-                if (e.get("cat") == "collective"
-                        and e.get("args", {}).get("bucket") is not None):
-                    label = f"{name}:{e['args']['bucket']}"
+                if e.get("cat") == "collective":
+                    args = e.get("args", {})
+                    ids = [str(args[k]) for k in ("group", "bucket")
+                           if args.get(k) is not None]
+                    if ids:
+                        label = f"{name}:{'.'.join(ids)}"
                 key = f"{key}/[{label}]" if key != name else f"[{label}]"
         else:
             key = name

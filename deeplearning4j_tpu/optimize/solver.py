@@ -30,7 +30,8 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 
 def train_step_math(net, params, state, opt_state, it, rng, x, y,
-                    lmask=None, fmask=None, grad_sync=None, update_fn=None):
+                    lmask=None, fmask=None, grad_sync=None, update_fn=None,
+                    with_health=False):
     """THE single-step update: loss+grads -> updater -> new carry. Every
     SGD-path program — Solver per-step and scan-window, ParallelWrapper
     sync per-step and sync window — traces exactly this function, so the
@@ -47,15 +48,28 @@ def train_step_math(net, params, state, opt_state, it, rng, x, y,
     receives whatever ``grad_sync`` produced (the full tree, or its
     local gradient shards). Both seams live in THIS function so the
     fused scan window carries the same sync + update structure as the
-    per-step path — structurally, not by convention."""
+    per-step path — structurally, not by convention.
+
+    ``with_health=True`` (the armed TrainingWatch, telemetry/slo.py)
+    additionally returns a [3] f32 health vector — loss, grad-norm²,
+    non-finite count — computed INSIDE this same program on the PRE-sync
+    local grads, so watching costs zero extra dispatches and zero host
+    syncs (the watch materializes it on its own worker thread at window
+    boundaries). The params/opt math is untouched either way."""
     def lf(p):
         return net.loss_fn(p, state, x, y, train=True, rng=rng,
                            labels_mask=lmask, features_mask=fmask)
     (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+    health = None
+    if with_health:
+        from ..telemetry.slo import training_health_vec
+        health = training_health_vec(loss, grads)
     if grad_sync is not None:
         grads = grad_sync(grads)
     update = net.updater.update if update_fn is None else update_fn
     new_params, new_opt = update(grads, opt_state, params, it)
+    if with_health:
+        return new_params, new_state, new_opt, loss, health
     return new_params, new_state, new_opt, loss
 
 
@@ -65,20 +79,22 @@ class Solver:
         self._steps = {}
 
     # -------------------------------------------------------------- step fns
-    def _get_step(self, has_lmask: bool, has_fmask: bool):
-        key = (has_lmask, has_fmask)
+    def _get_step(self, has_lmask: bool, has_fmask: bool,
+                  health: bool = False):
+        key = (has_lmask, has_fmask, health)
         if key in self._steps:
             return self._steps[key]
         net = self.net
 
         def step(params, state, opt_state, it, rng, x, y, lmask=None, fmask=None):
             return train_step_math(net, params, state, opt_state, it, rng,
-                                   x, y, lmask, fmask)
+                                   x, y, lmask, fmask, with_health=health)
 
         self._steps[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._steps[key]
 
-    def _get_window_step(self, has_lmask: bool, has_fmask: bool):
+    def _get_window_step(self, has_lmask: bool, has_fmask: bool,
+                         health: bool = False):
         """ONE jitted, buffer-donated lax.scan program for a K-step window:
         params/state/opt_state as carry, stacked [K, ...] batches as xs,
         per-step losses as ys. The scan body is the same math as
@@ -91,8 +107,10 @@ class Solver:
         round-trip per window.
         K itself is not part of the cache key — scan length comes from
         the stacked shapes (XLA recompiles per distinct K, as it would
-        per distinct batch shape)."""
-        key = ("window", has_lmask, has_fmask)
+        per distinct batch shape). ``health=True`` stacks the per-step
+        [3] health vectors as a second scan output ([K, 3] — the armed
+        TrainingWatch's window flush reads them off-thread)."""
+        key = ("window", has_lmask, has_fmask, health)
         if key in self._steps:
             return self._steps[key]
         net = self.net
@@ -109,13 +127,19 @@ class Solver:
                 lm = inp[2] if has_lmask else None
                 fm = inp[2 + int(has_lmask)] if has_fmask else None
                 rng = jax.random.fold_in(base_rng, it)
-                new_params, new_state, new_opt, loss = train_step_math(
-                    net, params, state, opt_state, it, rng, x, y, lm, fm)
-                return (new_params, new_state, new_opt, it + 1), loss
+                out = train_step_math(
+                    net, params, state, opt_state, it, rng, x, y, lm, fm,
+                    with_health=health)
+                new_params, new_state, new_opt = out[0], out[1], out[2]
+                ys_out = (out[3], out[4]) if health else out[3]
+                return (new_params, new_state, new_opt, it + 1), ys_out
 
-            (params, state, opt_state, _), losses = jax.lax.scan(
+            (params, state, opt_state, _), scanned = jax.lax.scan(
                 body, (params, state, opt_state, it0), seq)
-            return params, state, opt_state, losses
+            if health:
+                losses, healths = scanned
+                return params, state, opt_state, losses, healths
+            return params, state, opt_state, scanned
 
         self._steps[key] = jax.jit(window_step, donate_argnums=(0, 2))
         return self._steps[key]
@@ -278,20 +302,39 @@ class Solver:
         # shared no-ops (pinned by the sync-freedom + overhead tier-1
         # tests).
         reg = get_registry()
-        with span("fit", epochs=epochs, steps_per_dispatch=fused_k,
-                  net=type(net).__name__):
-            for epoch in range(epochs):
-                with span("epoch", index=epoch):
-                    self._fit_epoch(net, it_wrapped, prefetcher, iterator,
-                                    dtype, base_rng, perf, fused_k, tbptt,
-                                    second_order, reg,
-                                    skip=(skip_first_batches
-                                          if epoch == 0 else 0))
+        # Training-health watch (telemetry/slo.py): when one is armed the
+        # SGD step programs carry the in-program health output; tbptt and
+        # second-order keep their own step structure and are not watched.
+        watch = None
+        if not tbptt and second_order is None:
+            from ..telemetry.slo import get_training_watch
+            watch = get_training_watch()
+        # Request tracing: every span/event under this fit carries ONE
+        # trace id — the caller's active context (e.g. ElasticTrainer's
+        # supervised run) or a fresh one per fit call.
+        from ..telemetry.tracecontext import (current_trace_context,
+                                              new_trace_context,
+                                              use_trace_context)
+        ctx = current_trace_context()
+        with use_trace_context(ctx if ctx is not None
+                               else new_trace_context()):
+            with span("fit", epochs=epochs, steps_per_dispatch=fused_k,
+                      net=type(net).__name__):
+                for epoch in range(epochs):
+                    with span("epoch", index=epoch):
+                        self._fit_epoch(net, it_wrapped, prefetcher,
+                                        iterator, dtype, base_rng, perf,
+                                        fused_k, tbptt, second_order, reg,
+                                        skip=(skip_first_batches
+                                              if epoch == 0 else 0),
+                                        watch=watch)
+        if watch is not None:
+            watch.flush()          # end-of-fit is a window boundary too
         return net
 
     def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, dtype,
                    base_rng, perf, fused_k, tbptt, second_order, reg,
-                   skip: int = 0):
+                   skip: int = 0, watch=None):
         for l in net.listeners:
             if isinstance(l, TrainingListener):
                 l.on_epoch_start(net)
@@ -339,18 +382,22 @@ class Solver:
                     xs, ys, lms, fms = item.stacked(
                         cast=lambda a: _cast_features(a, dtype))
                     step_fn = self._get_window_step(lms is not None,
-                                                    fms is not None)
+                                                    fms is not None,
+                                                    health=watch is not None)
                     kwargs = {}
                     if lms is not None:
                         kwargs["lmasks"] = lms
                     if fms is not None:
                         kwargs["fmasks"] = fms
+                    it0 = net.iteration_count
                     with span("dispatch", k=k):
-                        net.params, net.state, net.opt_state, losses = \
-                            step_fn(net.params, net.state, net.opt_state,
-                                    jnp.asarray(net.iteration_count,
-                                                jnp.int32),
-                                    base_rng, xs, ys, **kwargs)
+                        out = step_fn(net.params, net.state, net.opt_state,
+                                      jnp.asarray(it0, jnp.int32),
+                                      base_rng, xs, ys, **kwargs)
+                    net.params, net.state, net.opt_state, losses = out[:4]
+                    if watch is not None:
+                        # [K, 3] device stack: appended, never read here
+                        watch.on_health(it0, out[4], k)
                     device_ms = max(
                         (time.perf_counter() - _etl_t0) * 1e3 - etl_ms, 0.0)
                     _c_windows.inc()
@@ -391,17 +438,22 @@ class Solver:
                     loss = self._fit_tbptt_batch(x, y, lmask, fmask,
                                                  base_rng)
                 else:
-                    step_fn = self._get_step(lmask is not None, fmask is not None)
+                    step_fn = self._get_step(lmask is not None,
+                                             fmask is not None,
+                                             health=watch is not None)
                     rng = jax.random.fold_in(base_rng, net.iteration_count)
                     kwargs = {}
                     if lmask is not None:
                         kwargs["lmask"] = lmask
                     if fmask is not None:
                         kwargs["fmask"] = fmask
-                    net.params, net.state, net.opt_state, loss = step_fn(
+                    out = step_fn(
                         net.params, net.state, net.opt_state,
                         jnp.asarray(net.iteration_count, jnp.int32),
                         rng, x, y, **kwargs)
+                    net.params, net.state, net.opt_state, loss = out[:4]
+                    if watch is not None:
+                        watch.on_health(net.iteration_count, out[4], 1)
                 # listeners get the index of the last executed iteration
                 it_idx = net.iteration_count - 1 if tbptt else net.iteration_count
                 # device_ms: the iteration's wall time net of ETL wait —

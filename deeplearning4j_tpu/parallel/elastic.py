@@ -47,6 +47,9 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import get_registry, span
+from ..telemetry.flightrec import get_flight_recorder
+from ..telemetry.tracecontext import (current_trace_context, event,
+                                      new_trace_context, use_trace_context)
 from ..util.async_checkpoint import AsyncCheckpointWriter, PreemptionGuard
 from ..util.distributed_checkpoint import (latest_sharded_step,
                                            restore_latest_sharded_checkpoint)
@@ -348,6 +351,11 @@ class ElasticTrainer:
 
     def _switch_mode(self, to: str) -> None:
         self.degraded_transitions += 1
+        # SparkNet degraded-mode decisions leave an evidence trail: an
+        # instant event in the trace ring (any later flight dump shows
+        # when and why the mode flipped), not just a counter
+        event("elastic.mode_switch", to=to, step=self.net.iteration_count,
+              budget_ms=self.sync_latency_budget_ms)
         self.mode_history.append((self.net.iteration_count, to))
         self.mode = to
         self._lat.clear()
@@ -364,6 +372,13 @@ class ElasticTrainer:
     # --------------------------------------------------------------- recover
     def _recover(self, exc: BaseException) -> None:
         self.recoveries += 1
+        # black box BEFORE touching anything: what the trainer was doing
+        # in the moments before the worker loss is exactly what the ring
+        # still holds
+        get_flight_recorder().dump(
+            "elastic_recovery", reason=str(exc),
+            reason_type=type(exc).__name__, attempt=self.recoveries,
+            step=self.net.iteration_count, mesh_devices=len(self._devices))
         if self._reg.enabled:
             self._reg.counter("elastic.recoveries").inc()
         if self.recoveries > self.max_recoveries:
@@ -488,7 +503,14 @@ class ElasticTrainer:
         if self.checkpoint_dir is not None:
             self._writer = AsyncCheckpointWriter(
                 self.checkpoint_dir, keep_last=self.keep_last, registry=reg)
+        # ONE trace id for the whole supervised run (checkpoints, mode
+        # switches, recoveries included) — the step_callback loop and the
+        # inner ParallelWrapper.fit spans all stamp it
+        _ctx = current_trace_context()
+        _trace_scope = use_trace_context(
+            _ctx if _ctx is not None else new_trace_context())
         try:
+            _trace_scope.__enter__()
             with span("elastic.fit", num_steps=num_steps,
                       devices=len(self._devices)):
                 if self.checkpoint_dir is not None:
@@ -550,7 +572,14 @@ class ElasticTrainer:
                     self.preempted = True
                     if reg.enabled:
                         reg.counter("elastic.preemptions").inc()
+                    # SIGTERM black box: flushed from the loop thread at
+                    # the step boundary (the handler only sets a flag —
+                    # dumping from a signal handler could deadlock)
+                    get_flight_recorder().dump(
+                        "preemption", step=net.iteration_count,
+                        steps_target=num_steps)
         finally:
+            _trace_scope.__exit__(None, None, None)
             writer, self._writer = self._writer, None
             if writer is not None:
                 try:

@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Set
 
+from ..telemetry.flightrec import get_flight_recorder
 from ..util.distributed_checkpoint import (_shard_files,
                                            list_sharded_checkpoints)
 
@@ -190,6 +191,7 @@ class FaultInjector:
             if isinstance(f, CorruptCheckpoint):
                 f.fired = True
                 self._apply_corrupt(f, trainer)
+                self._blackbox(f, step)
             elif isinstance(f, PreemptAt):
                 f.fired = True
                 if trainer is not None:
@@ -204,11 +206,20 @@ class FaultInjector:
         if kill is not None:
             kill.fired = True
             self.failed_workers.add(kill.worker)
+            # dump BEFORE raising: every chaos run leaves a readable
+            # black box of the spans/events preceding the injected loss
+            self._blackbox(kill, step, worker=kill.worker)
             raise WorkerLostError(kill.worker, step)
         for f in self.plan:
             if isinstance(f, SlowCollective) and f.sleep \
                     and f.step <= step < f.until_step:
                 time.sleep(f.delay_ms / 1e3)
+
+    @staticmethod
+    def _blackbox(fault: Fault, step: int, **info) -> None:
+        get_flight_recorder().dump(
+            f"fault_{type(fault).__name__.lower()}", step=step,
+            planned_step=fault.step, **info)
 
     def _apply_corrupt(self, f: CorruptCheckpoint, trainer) -> None:
         directory = getattr(trainer, "checkpoint_dir", None)

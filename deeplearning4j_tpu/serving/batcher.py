@@ -18,6 +18,8 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.flightrec import get_flight_recorder
+from ..telemetry.tracecontext import current_trace_id, event
 from .buckets import BucketLadder
 from .errors import (DeadlineExceededError, DrainingError, QueueFullError,
                      ShapeMismatchError)
@@ -26,7 +28,7 @@ from .metrics import ServingMetrics
 
 class _Request:
     __slots__ = ("x", "n", "event", "result", "error", "enqueue_t",
-                 "deadline", "abandoned")
+                 "deadline", "abandoned", "trace_id")
 
     def __init__(self, x: np.ndarray, deadline: float):
         self.x = x
@@ -37,6 +39,11 @@ class _Request:
         self.enqueue_t = time.monotonic()
         self.deadline = deadline
         self.abandoned = False        # caller gave up (deadline expired)
+        # request tracing: the submitter's trace id rides the queued
+        # request across the handoff to the dispatch thread, which stamps
+        # it on the per-request batch events (None = untraced caller:
+        # zero per-request trace cost)
+        self.trace_id = current_trace_id()
 
 
 class ShapeBucketedBatcher:
@@ -134,6 +141,9 @@ class ShapeBucketedBatcher:
                     f"({self.queue_limit} requests)")
             self._dq.append(req)
             self._cond.notify_all()
+        if req.trace_id is not None:
+            event("serving.admit", model=self.name, rows=req.n,
+                  queue_depth=len(self._dq))
         return req
 
     def _await(self, req: _Request, deadline: float) -> np.ndarray:
@@ -231,10 +241,25 @@ class ShapeBucketedBatcher:
             for r in batch:
                 r.error = e
                 r.event.set()
+            # black box AFTER resolving the callers (a slow dump write
+            # must never eat into their deadlines); force=False because
+            # the loop keeps dispatching after a failure — a persistently
+            # failing runner must not write a dump per batch window
+            get_flight_recorder().dump(
+                "serving_dispatch_error", force=False, model=self.name,
+                bucket=bucket, rows=total, error=str(e),
+                error_type=type(e).__name__)
             return
         self.metrics.record_batch(bucket, total)
         for r in batch:
             self.metrics.record_queue_wait((t_disp - r.enqueue_t) * 1000.0)
+            if r.trace_id is not None:
+                # cross-thread handoff: the dispatch thread has no trace
+                # context of its own — each request's id is stamped
+                # explicitly on its batch event
+                event("serving.batch", trace_id=r.trace_id,
+                      model=self.name, bucket=bucket, rows=r.n,
+                      queue_ms=round((t_disp - r.enqueue_t) * 1e3, 3))
         off = 0
         for r in batch:
             r.result = out[off:off + r.n]

@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Sequence, Set
 import numpy as np
 
 from ...telemetry import RecompileDetector, span
+from ...telemetry.flightrec import get_flight_recorder
+from ...telemetry.tracecontext import current_trace_id, event
 from ..errors import (BlockPoolExhaustedError, DeadlineExceededError,
                       DrainingError, GenerationClosedError, QueueFullError,
                       ShapeMismatchError)
@@ -102,7 +104,8 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "stop",
                  "deadline", "stream", "slot", "blocks", "emitted",
-                 "cancelled", "cancel_reason", "enqueue_t", "cohort")
+                 "cancelled", "cancel_reason", "enqueue_t", "cohort",
+                 "trace_id")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: int, stop: frozenset, deadline: float):
@@ -121,6 +124,10 @@ class _GenRequest:
         self.cancelled = False
         self.cancel_reason = "cancelled"
         self.enqueue_t = time.monotonic()
+        # the submitter's trace id rides the request across the queue
+        # handoff into the decode loop thread (None = untraced: the
+        # per-token trace events are skipped entirely)
+        self.trace_id = current_trace_id()
 
     def _cancel(self):
         self.cancelled = True
@@ -240,6 +247,9 @@ class ModelRuntime:
             self.metrics.record_request()
             self._queue.append(req)
             self._cond.notify_all()
+        if req.trace_id is not None:
+            event("generation.submit", model=self.name, prompt_len=plen,
+                  max_tokens=int(max_new))
         return req.stream
 
     # ------------------------------------------------------------ loop body
@@ -327,6 +337,14 @@ class ModelRuntime:
             slots[i] = r.slot
             temp[i] = r.temperature
             topk[i] = r.top_k
+        for r in cands:
+            if r.trace_id is not None:
+                # admission: queue -> slot handoff, stamped per request
+                # (the loop thread has no context of its own)
+                event("generation.admit", trace_id=r.trace_id,
+                      model=self.name, slot=r.slot,
+                      queue_ms=round((time.monotonic() - r.enqueue_t) * 1e3,
+                                     3))
         with span("generation.prefill", model=self.name, batch=len(cands),
                   rung=L):
             first, coh.cache, self._key = coh.ps.run_prefill(
@@ -341,6 +359,11 @@ class ModelRuntime:
             self._pos[s] = len(r.prompt)
             self._temp[s] = r.temperature
             self._topk[s] = r.top_k
+            if r.trace_id is not None:
+                event("generation.prefill", trace_id=r.trace_id,
+                      model=self.name, slot=s, rung=int(L),
+                      batch=len(cands),
+                      ttft_ms=round((now - r.enqueue_t) * 1e3, 3))
             did_emit, _ = self._slot_emit(coh, r, int(first[i]), now)
             emitted += did_emit
         self.metrics.record_prefill(
@@ -367,6 +390,12 @@ class ModelRuntime:
             emitted = 0
             for s in live:
                 r = self._slot_req[s]
+                if r.trace_id is not None:
+                    # one event per decode step the request participated
+                    # in — the per-request timeline's heartbeat
+                    event("generation.decode_step", trace_id=r.trace_id,
+                          model=self.name, slot=s, token_index=r.emitted,
+                          step_ms=round(dt_ms, 3))
                 did_emit, cont = self._slot_emit(coh, r, int(nxt[s]), now)
                 emitted += did_emit
                 if cont:
@@ -413,6 +442,10 @@ class ModelRuntime:
                      error: Optional[BaseException] = None):
         s = r.slot
         r.stream._finish(reason, error)
+        if r.trace_id is not None:
+            event("generation.finish", trace_id=r.trace_id,
+                  model=self.name, slot=s, reason=reason,
+                  tokens=r.emitted)
         self.metrics.record_finish(reason)
         if r.blocks:
             coh.allocator.free(r.blocks)
@@ -439,11 +472,19 @@ class ModelRuntime:
             queued = list(self._queue)
             self._queue.clear()
             reqs = list(self._slot_req.values())
+        in_flight = len(reqs)
         for r in queued:
             r.stream._finish("error", exc)
         for r in reqs:
             self._finish_slot(r.cohort, r, "error", exc)
         self._cohorts = []
+        # black box AFTER resolving every caller (a slow dump write must
+        # never delay their failure); the ring still holds the
+        # spans/events — and trace ids — leading up to the failure
+        get_flight_recorder().dump(
+            "generation_error", model=self.name, error=str(exc),
+            error_type=type(exc).__name__, in_flight=in_flight,
+            queued=len(queued))
 
     def _shutdown_flush(self):
         err = DrainingError(f"generation model '{self.name}' stopped")

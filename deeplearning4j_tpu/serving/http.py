@@ -40,6 +40,11 @@ from typing import Optional
 
 import numpy as np
 
+from ..telemetry import get_registry
+from ..telemetry.flightrec import get_flight_recorder
+from ..telemetry.slo import get_slo_watchdog
+from ..telemetry.tracecontext import (event, new_trace_context,
+                                      use_trace_context)
 from ..util.retry import RetryError, RetryPolicy
 from .engine import InferenceEngine
 from .errors import (BlockPoolExhaustedError, DeadlineExceededError,
@@ -104,7 +109,47 @@ class ServingHTTPServer:
         class Handler(hs.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"    # required for chunked replies
 
+            # ------------------------------------------------ request tracing
+            # Every request runs under a TraceContext: the inbound
+            # X-Trace-Id header when present (normalized hex), a fresh
+            # 128-bit id otherwise — echoed back on EVERY response (the
+            # end_headers override covers write_json AND the chunked
+            # streaming path), and stamped by every span/event the
+            # request touches on its way through admission, batching,
+            # prefill and decode.
+            _trace_ctx = None
+
+            def _traced(self):
+                ctx = new_trace_context(self.headers.get("X-Trace-Id"))
+                self._trace_ctx = ctx
+                return use_trace_context(ctx)
+
+            def end_headers(self):
+                ctx = self._trace_ctx
+                if ctx is not None:
+                    self.send_header("X-Trace-Id", ctx.trace_id)
+                super().end_headers()
+
             def do_GET(self):       # noqa: N802
+                try:
+                    with self._traced():
+                        self._route_get()
+                finally:
+                    # keep-alive: a later malformed request on this
+                    # connection answered via send_error (outside any
+                    # _traced scope) must not echo THIS request's id
+                    self._trace_ctx = None
+
+            def do_POST(self):      # noqa: N802
+                try:
+                    with self._traced():
+                        event("http.request", method="POST",
+                              route=self.path)
+                        self._route_post()
+                finally:
+                    self._trace_ctx = None
+
+            def _route_get(self):
                 if self.path == "/health":
                     depths = engine.queue_depths() if engine else {}
                     gdepths = generation.queue_depths() if generation else {}
@@ -126,7 +171,24 @@ class ServingHTTPServer:
                     if generation is not None:
                         body = dict(body)
                         body["generation"] = generation.metrics()
+                    wd = get_slo_watchdog()
+                    if wd is not None:
+                        # fresh evaluation per scrape: burn rates move
+                        # with the counters, not with a stale snapshot
+                        body = dict(body)
+                        body["slo"] = wd.check()
                     write_json(self, 200, body)
+                elif self.path == "/metrics/prometheus":
+                    wd = get_slo_watchdog()
+                    if wd is not None:
+                        wd.check()        # refresh slo.* gauges pre-dump
+                    text = get_registry().to_prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
                 elif self.path == "/models":
                     body = engine.models() if engine else {}
                     if generation is not None:
@@ -136,7 +198,7 @@ class ServingHTTPServer:
                 else:
                     write_json(self, 404, {"error": f"no route {self.path}"})
 
-            def do_POST(self):      # noqa: N802
+            def _route_post(self):
                 if self.path == "/predict" or \
                         self.path.startswith("/predict/"):
                     self._predict()
@@ -145,9 +207,35 @@ class ServingHTTPServer:
                     self._generate()
                 elif self.path == "/reload":
                     self._reload()
+                elif self.path == "/debug/flightrec":
+                    self._flightrec()
                 else:
                     self._drain_body()
                     write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def _flightrec(self):
+                """Explicit black-box dump: the operator's 'what has this
+                process been doing' button. Body (optional JSON) fields
+                land in the dump's info block."""
+                try:
+                    info = read_json(self)
+                    if not isinstance(info, dict):
+                        info = {"note": info}
+                except Exception:
+                    info = {}
+                # body keys must not collide with dump()'s own parameters
+                # (a {"trigger": ...} or {"self": ...} body would
+                # TypeError, {"force": false} would silently rate-limit)
+                safe = {("body_" + k if k in ("self", "trigger", "force")
+                         else str(k)): v for k, v in info.items()}
+                path = get_flight_recorder().dump("http_debug", **safe)
+                if path is None:
+                    write_json(self, 503,
+                               {"error": "flight recorder unavailable "
+                                         "(telemetry disabled or dump "
+                                         "failed)"})
+                    return
+                write_json(self, 200, {"dumped": path})
 
             def _drain_body(self):
                 """Error paths that respond BEFORE parsing must still

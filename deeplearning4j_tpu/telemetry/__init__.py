@@ -20,19 +20,36 @@ disable it all with ``get_registry().enabled = False`` (a near-no-op; the
 ``telemetry_overhead_pct`` bench row guards <5% enabled overhead on a
 dispatch-bound loop).
 """
+from .flightrec import (FlightRecorder, configure_flight_recorder,
+                        get_flight_recorder, set_flight_recorder)
 from .jaxsignals import (HostSyncDetector, HostSyncError, RecompileDetector,
                          device_memory_gauges, ensure_monitoring_hook,
                          xla_compile_count)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, set_registry)
+from .slo import (ErrorRateSLO, LatencySLO, SLOWatchdog, TrainingWatch,
+                  get_slo_watchdog, get_training_watch, set_slo_watchdog,
+                  set_training_watch)
 from .spans import (Span, current_span, current_span_path,
                     record_external_span, span)
+from .tracecontext import (TraceContext, adopt, current_trace_context,
+                           current_trace_id, event, handoff,
+                           new_trace_context, normalize_trace_id,
+                           use_trace_context)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "get_registry", "set_registry",
     "Span", "span", "current_span", "current_span_path",
     "record_external_span",
+    "TraceContext", "new_trace_context", "normalize_trace_id",
+    "current_trace_context", "current_trace_id", "use_trace_context",
+    "handoff", "adopt", "event",
+    "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+    "configure_flight_recorder",
+    "SLOWatchdog", "LatencySLO", "ErrorRateSLO",
+    "get_slo_watchdog", "set_slo_watchdog",
+    "TrainingWatch", "get_training_watch", "set_training_watch",
     "RecompileDetector", "HostSyncDetector", "HostSyncError",
     "device_memory_gauges", "xla_compile_count", "ensure_monitoring_hook",
     "reset",

@@ -36,7 +36,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .registry import MetricsRegistry, get_registry
-from .spans import _EPOCH_NS, current_span_path
+from .spans import _EPOCH_NS, current_span, current_span_path
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -98,6 +98,42 @@ def xla_compile_count() -> int:
     return _compile_count
 
 
+_STDLIB_DIR = None
+_THIS_PKG_DIR = None
+
+
+def _source_hint() -> str:
+    """Best-effort 'file.py:line in func' for the user code driving the
+    current compile: the innermost stack frame that is neither installed
+    jax internals, the stdlib (contextlib/threading wrappers around the
+    compile call), nor this telemetry package. Filters are anchored to
+    site-packages / this package's own directory so a user file that
+    merely CONTAINS 'jax' or 'telemetry' in its path is never skipped.
+    Only computed when a flagged warning is being emitted — the stack
+    walk is microseconds next to the multi-ms compile it annotates."""
+    import os as _os
+    import traceback
+    global _STDLIB_DIR, _THIS_PKG_DIR
+    if _STDLIB_DIR is None:
+        import sysconfig
+        _STDLIB_DIR = sysconfig.get_paths()["stdlib"].replace("\\", "/")
+        _THIS_PKG_DIR = _os.path.dirname(
+            _os.path.abspath(__file__)).replace("\\", "/")
+    try:
+        for frame in reversed(traceback.extract_stack()):
+            fn = frame.filename.replace("\\", "/")
+            if "/site-packages/jax" in fn or "/dist-packages/jax" in fn:
+                continue               # jax/jaxlib/jax_* installs
+            if fn.startswith(_THIS_PKG_DIR):
+                continue               # this telemetry package
+            if fn.startswith(_STDLIB_DIR) and "-packages" not in fn:
+                continue               # contextlib/threading plumbing
+            return f"{fn}:{frame.lineno} in {frame.name}"
+    except Exception:
+        pass
+    return ""
+
+
 class RecompileDetector:
     """Scoped recompile watchdog: counts backend compiles while armed and
     attributes each to the active span path of the compiling thread.
@@ -105,11 +141,15 @@ class RecompileDetector:
         with RecompileDetector(allowed=0) as det:
             serve_steady_state_traffic()
         det.count            # compiles observed in scope
-        det.events           # [{"span_path", "duration_s", "wall_time"}]
+        det.events           # [{"span_path", "span_attrs", "source",
+                             #   "duration_s", "wall_time"}]
 
     ``allowed`` compiles (warm-up budget) pass silently; every compile
-    beyond it logs a WARNING naming the offending span path, the signal
-    PR 3's test-only counter could not give: *where* the retrace happened.
+    beyond it logs a WARNING naming the offending span path, that span's
+    attrs (iteration/shape/model context the instrumentation already
+    attached) and a best-effort source hint — so a steady-state recompile
+    is actionable ("iteration 14 recompiled, driven from train.py:88")
+    rather than just counted.
     """
 
     def __init__(self, *, allowed: int = 0, warn: bool = True,
@@ -123,17 +163,31 @@ class RecompileDetector:
 
     def _on_compile(self, span_path: str, secs: float) -> None:
         self.count += 1
+        sp = current_span()           # innermost span of the compiling thread
+        attrs = {k: v for k, v in (sp.attrs if sp is not None else {}).items()
+                 if k != "path"}
+        # the stack walk is only paid when the compile is actually going
+        # to be FLAGGED (past the warm-up budget on a warning detector) —
+        # a silently-counting detector (the generation decode loop keeps
+        # one armed permanently) adds nothing to legitimate compiles
+        flagged = self.warn and self.count > self.allowed
+        source = _source_hint() if flagged else ""
         self.events.append({"span_path": span_path,
+                            "span_attrs": attrs,
+                            "source": source,
                             "duration_s": round(secs, 6),
                             "wall_time": time.time()})
         if self.registry.enabled:
             self.registry.counter("jax.recompiles_flagged").inc()
-        if self.warn and self.count > self.allowed:
+        if flagged:
             log.warning(
                 "RecompileDetector: backend compile #%d (%.1f ms) during "
-                "span '%s' — a steady-state loop should not trace; check "
-                "for shape/dtype instability or un-jitted host control "
-                "flow", self.count, secs * 1e3, span_path or "<no span>")
+                "span '%s'%s%s — a steady-state loop should not trace; "
+                "check for shape/dtype instability or un-jitted host "
+                "control flow", self.count, secs * 1e3,
+                span_path or "<no span>",
+                f" (span attrs: {attrs})" if attrs else "",
+                f" (driven from {source})" if source else "")
 
     def __enter__(self) -> "RecompileDetector":
         ensure_monitoring_hook()

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -37,6 +38,22 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
         return 0.0
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
+
+
+# Prometheus-conformant histogram buckets (``le`` upper bounds, ms-scaled:
+# most histograms here are latencies in milliseconds). Cumulative counts
+# are maintained in observe() — unlike the percentile ring these are
+# LIFETIME totals, the semantics scrapers expect.
+DEFAULT_BUCKET_BOUNDS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                         250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+                         30000.0, 60000.0)
+
+
+def escape_label_value(v) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double-quote and newline must be escaped inside the quotes."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class Counter:
@@ -88,15 +105,23 @@ class Gauge:
 
 class Histogram:
     """Bounded ring of recent observations; percentiles computed lazily at
-    snapshot time (p50/p95/p99), plus lifetime count and sum."""
+    snapshot time (p50/p95/p99), plus lifetime count/sum and cumulative
+    ``le``-bucket counts (Prometheus histogram semantics; also what the
+    SLO watchdog's latency objectives read via :meth:`count_le`)."""
 
-    __slots__ = ("name", "_ring", "_count", "_sum", "_lock")
+    __slots__ = ("name", "_ring", "_count", "_sum", "_lock", "_bounds",
+                 "_bucket_counts")
 
-    def __init__(self, name: str, window: int = 4096):
+    def __init__(self, name: str, window: int = 4096,
+                 bounds: tuple = DEFAULT_BUCKET_BOUNDS):
         self.name = name
         self._ring: deque = deque(maxlen=window)
         self._count = 0
         self._sum = 0.0
+        self._bounds = tuple(float(b) for b in bounds)
+        # non-cumulative per-bucket tallies (+1 slot for > last bound);
+        # cumulated lazily at read time so observe() stays one index + add
+        self._bucket_counts = [0] * (len(self._bounds) + 1)
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -104,6 +129,7 @@ class Histogram:
             self._ring.append(v)
             self._count += 1
             self._sum += v
+            self._bucket_counts[bisect_left(self._bounds, v)] += 1
 
     @property
     def count(self) -> int:
@@ -112,6 +138,34 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    @property
+    def bounds(self) -> tuple:
+        return self._bounds
+
+    def cumulative_buckets(self) -> List[int]:
+        """Cumulative count per ``le`` bound (last entry == +Inf == count)."""
+        with self._lock:
+            out, acc = [], 0
+            for c in self._bucket_counts:
+                acc += c
+                out.append(acc)
+        return out
+
+    def count_le(self, threshold: float) -> int:
+        """Lifetime observations <= the smallest bucket bound covering
+        ``threshold`` (exact when the threshold IS a bound — pick SLO
+        thresholds from the bucket grid for exact accounting)."""
+        return self.count_le_and_total(threshold)[0]
+
+    def count_le_and_total(self, threshold: float) -> tuple:
+        """(count_le, lifetime_count) read under ONE lock — the SLO
+        watchdog's good/bad split must come from a consistent snapshot
+        (two separate reads racing observe() would mint phantom bad
+        observations and poison the window baselines)."""
+        idx = bisect_left(self._bounds, float(threshold))
+        with self._lock:
+            return sum(self._bucket_counts[:idx + 1]), self._count
 
     def percentiles(self) -> Dict[str, float]:
         with self._lock:
@@ -152,9 +206,19 @@ class _NullHistogram:
     name = "<disabled>"
     count = 0
     sum = 0.0
+    bounds = ()
 
     def observe(self, v: float) -> None:
         pass
+
+    def cumulative_buckets(self) -> List[int]:
+        return []
+
+    def count_le(self, threshold: float) -> int:
+        return 0
+
+    def count_le_and_total(self, threshold: float) -> tuple:
+        return (0, 0)
 
     def percentiles(self) -> Dict[str, float]:
         return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
@@ -252,6 +316,26 @@ class MetricsRegistry:
             f.write("]\n")
         return path
 
+    def write_trace_jsonl(self, path: str,
+                          trace_id: Optional[str] = None) -> str:
+        """Write the trace buffer as bare JSONL (one event object per
+        line — what ``tools/trace2summary.py``/``trace2timeline.py``
+        read), optionally filtered to one request's ``trace_id`` (the
+        wire-format id is accepted: normalized like the HTTP ingress and
+        the CLI filters normalize it)."""
+        events = self.trace_events()
+        if trace_id is not None:
+            from .tracecontext import normalize_trace_id
+            want = normalize_trace_id(trace_id)
+            events = [] if want is None else \
+                [e for e in events
+                 if e.get("args", {}).get("trace_id") == want]
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev))
+                f.write("\n")
+        return path
+
     # -------------------------------------------------------------- reporting
     def snapshot(self) -> dict:
         """JSON-ready dump of every metric (histograms as p50/p95/p99 +
@@ -267,10 +351,16 @@ class MetricsRegistry:
                 "spans_recorded": len(self._trace),
                 "spans_dropped": self._trace_dropped}
 
-    def to_prometheus_text(self, prefix: str = "dl4j_tpu") -> str:
+    def to_prometheus_text(self, prefix: str = "dl4j_tpu", *,
+                           compat_quantiles: bool = False) -> str:
         """Prometheus text exposition format. Metric names are sanitized
-        (dots/dashes -> underscores); histograms export _count, _sum and
-        quantile gauges (summary-style)."""
+        (dots/dashes -> underscores), label values escaped per the
+        exposition spec. Histograms export conformant
+        ``_bucket{le="..."}`` cumulative counts (``le="+Inf"`` == the
+        lifetime count) plus ``_sum``/``_count``. ``compat_quantiles``
+        restores the pre-ISSUE-13 summary-style dump (ad-hoc
+        ``quantile=`` gauges from the bounded ring) for scrapers that
+        grew to depend on those keys."""
         def san(name: str) -> str:
             return "".join(ch if (ch.isalnum() or ch == "_") else "_"
                            for ch in name)
@@ -290,12 +380,24 @@ class MetricsRegistry:
             lines.append(f"{full} {g.value}")
         for n, h in hists:
             full = f"{prefix}_{san(n)}"
-            lines.append(f"# TYPE {full} summary")
-            for q, v in h.percentiles().items():
-                quant = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}[q]
-                lines.append(f"{full}{{quantile=\"{quant}\"}} {v}")
+            total = h.count
+            if compat_quantiles:
+                lines.append(f"# TYPE {full} summary")
+                for q, v in h.percentiles().items():
+                    quant = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}[q]
+                    lines.append(
+                        f'{full}{{quantile="{escape_label_value(quant)}"}}'
+                        f" {v}")
+            else:
+                lines.append(f"# TYPE {full} histogram")
+                cum = h.cumulative_buckets()
+                total = cum[-1] if cum else h.count   # one consistent read
+                for bound, cnt in zip(h.bounds, cum):
+                    le = escape_label_value(f"{bound:g}")
+                    lines.append(f'{full}_bucket{{le="{le}"}} {cnt}')
+                lines.append(f'{full}_bucket{{le="+Inf"}} {total}')
             lines.append(f"{full}_sum {h.sum}")
-            lines.append(f"{full}_count {h.count}")
+            lines.append(f"{full}_count {total}")
         return "\n".join(lines) + "\n"
 
     def publish(self, storage, session_id: str = "telemetry",

@@ -22,6 +22,7 @@ import time
 from typing import List, Optional
 
 from .registry import MetricsRegistry, get_registry
+from .tracecontext import current_trace_id
 
 __all__ = ["Span", "span", "current_span", "current_span_path",
            "record_external_span"]
@@ -72,7 +73,7 @@ class Span:
     and closes in a later one)."""
 
     __slots__ = ("name", "attrs", "path", "registry", "_t0", "_tid",
-                 "_ended")
+                 "_ended", "_trace_id")
 
     def __init__(self, name: str, registry: MetricsRegistry, attrs: dict):
         self.name = name
@@ -82,13 +83,24 @@ class Span:
         self._t0 = 0
         self._tid = 0
         self._ended = False
+        self._trace_id = None
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "Span":
         stack = _stack()
         if stack:
             self.path = stack[-1].path + "/" + self.name
+        else:
+            # a handed-off scope (tracecontext.adopt) installs the
+            # producer's span path as a virtual root: the first span a
+            # consumer thread opens parents under the producer's path
+            root = getattr(_tls, "virtual_root", "")
+            if root:
+                self.path = root + "/" + self.name
         stack.append(self)
+        # request tracing: stamp the ACTIVE trace context (if any) so the
+        # closed event is keyed by trace id alongside its span path
+        self._trace_id = current_trace_id()
         self._tid = threading.get_ident() & 0xFFFFFFFF
         self._t0 = time.perf_counter_ns()
         return self
@@ -110,6 +122,8 @@ class Span:
         if reg.enabled:
             args = self.attrs
             args["path"] = self.path
+            if self._trace_id is not None:
+                args["trace_id"] = self._trace_id
             reg.record_event({"name": self.name, "ph": "X", "cat": "span",
                               "ts": (self._t0 + _EPOCH_NS) // 1000,
                               "dur": dur_ns // 1000,
@@ -162,6 +176,9 @@ def record_external_span(name: str, dur_ms: float, cat: str = "external",
     # backend_compile events): trace2summary appends "[name]" itself
     args = dict(attrs)
     args["path"] = current_span_path()
+    tid_trace = current_trace_id()
+    if tid_trace is not None:
+        args["trace_id"] = tid_trace
     now_ns = time.perf_counter_ns()
     dur_us = max(0, int(dur_ms * 1000))
     reg.record_event({"name": name, "ph": "X", "cat": cat,

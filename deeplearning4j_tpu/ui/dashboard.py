@@ -95,10 +95,26 @@ def _render_telemetry_card(title: str) -> str:
     hists = snap["histograms"]
     if not (counters or gauges or hists):
         return ""
-    # headline signals first: the three the tentpole names
+    # headline signals first: the ones the tentpoles name
     headline = []
     if "jax.compiles" in counters:
         headline.append(("XLA compiles", counters["jax.compiles"]))
+    # SLO watchdog (telemetry/slo.py): breached objectives by name, plus
+    # the lifetime breach count and the flight-recorder evidence trail
+    breached = sorted(n[len("slo."):-len(".breached")]
+                      for n, g in gauges.items()
+                      if n.startswith("slo.") and n.endswith(".breached")
+                      and g["value"])
+    if breached:
+        headline.append(("SLO BREACHED", ", ".join(breached)))
+    if "slo.breaches" in counters:
+        headline.append(("SLO breaches (lifetime)", counters["slo.breaches"]))
+    if "flightrec.dumps" in counters:
+        headline.append(("flight-recorder dumps",
+                         counters["flightrec.dumps"]))
+    if "training_watch.unhealthy" in counters:
+        headline.append(("training unhealthy steps",
+                         counters["training_watch.unhealthy"]))
     pw = hists.get("prefetch.wait_ms")
     if pw:
         headline.append(("prefetch stall p95 (ms)", round(pw["p95"], 3)))

@@ -36,6 +36,19 @@ def rng():
     return np.random.default_rng(12345)
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _flightrec_sandbox(tmp_path_factory):
+    """Point the process-wide flight recorder at a session tmp dir: fault
+    injections and failure-path tests dump black boxes as a side effect,
+    and those must never land in the working tree."""
+    from deeplearning4j_tpu.telemetry import configure_flight_recorder
+    # small capacity: chaos/fault tests dump as a side effect dozens of
+    # times across the suite; 256-event tails keep that cheap
+    configure_flight_recorder(
+        directory=str(tmp_path_factory.mktemp("flightrec")),
+        capacity=256)
+
+
 def pytest_collection_modifyitems(config, items):
     """DL4J_TPU_TEST_REVERSE=1 reverses collection order — the harness for
     verifying the suite is order-independent (no test may depend on state

@@ -1,0 +1,303 @@
+"""Incident flight recorder (ISSUE 13 tentpole): dump-on-trigger black
+boxes from the telemetry trace ring.
+
+Acceptance pinned here: an injected KillWorker fault and an injected
+NaN-loss batch each produce a flight-recorder dump containing the
+preceding spans/events; unhandled scheduler/batcher exceptions, elastic
+preemption and POST /debug/flightrec leave dumps too; dumps are atomic,
+rate-limited for repeat-fire triggers, pruned to keep_last, and readable
+by the trace tools.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                          set_flight_recorder, span)
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = telemetry.set_registry(reg)
+    try:
+        yield reg
+    finally:
+        telemetry.set_registry(prev)
+
+
+@pytest.fixture
+def recorder(fresh_registry, tmp_path):
+    rec = FlightRecorder(directory=str(tmp_path / "fr"), capacity=64,
+                         min_interval_s=10.0, keep_last=3)
+    prev = set_flight_recorder(rec)
+    try:
+        yield rec
+    finally:
+        set_flight_recorder(prev)
+
+
+def _tiny_net(seed=12):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    conf = (NeuralNetConfiguration(seed=seed, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------------- core
+def test_dump_captures_ring_tail_metrics_and_deltas(recorder,
+                                                    fresh_registry):
+    reg = fresh_registry
+    reg.counter("work.items").inc(5)
+    with span("phase1"):
+        pass
+    p1 = recorder.dump("manual_one", context="first")
+    assert p1 and os.path.exists(p1)
+    rec1 = json.load(open(p1))
+    assert rec1["trigger"] == "manual_one"
+    assert rec1["info"]["context"] == "first"
+    assert [e["name"] for e in rec1["events"]] == ["phase1"]
+    assert rec1["metrics"]["counters"]["work.items"] == 5
+    assert rec1["counter_deltas_since_last_dump"]["work.items"] == 5
+    reg.counter("work.items").inc(2)
+    p2 = recorder.dump("manual_two")
+    rec2 = json.load(open(p2))
+    assert rec2["counter_deltas_since_last_dump"]["work.items"] == 2
+    assert rec2["seq"] == rec1["seq"] + 1
+    # no torn tmp files left behind
+    assert not [f for f in os.listdir(recorder.directory)
+                if f.endswith(".tmp")]
+
+
+def test_dump_capacity_bounds_events(recorder, fresh_registry):
+    for i in range(200):
+        fresh_registry.record_event({"name": f"e{i}", "ph": "i", "ts": i,
+                                     "pid": 1, "tid": 1, "args": {}})
+    p = recorder.dump("bounded")
+    rec = json.load(open(p))
+    assert len(rec["events"]) == 64               # capacity, most recent
+    assert rec["events"][-1]["name"] == "e199"
+
+
+def test_rate_limit_and_force(recorder):
+    assert recorder.dump("auto", force=False) is not None
+    assert recorder.dump("auto", force=False) is None     # suppressed
+    assert recorder.suppressed == 1
+    assert recorder.dump("explicit", force=True) is not None
+
+
+def test_keep_last_prunes_old_dumps(recorder):
+    paths = [recorder.dump(f"t{i}") for i in range(5)]
+    assert all(paths)
+    kept = sorted(os.listdir(recorder.directory))
+    assert len(kept) == 3                          # keep_last
+    assert os.path.basename(paths[-1]) in kept
+    assert os.path.basename(paths[0]) not in kept
+
+
+def test_disabled_registry_no_dump(recorder, fresh_registry):
+    fresh_registry.enabled = False
+    assert recorder.dump("nope") is None
+    fresh_registry.enabled = True
+
+
+def test_note_breadcrumbs_land_in_dump(recorder, fresh_registry):
+    recorder.note("drain_started", queued=7)
+    p = recorder.dump("with_note")
+    rec = json.load(open(p))
+    notes = [e for e in rec["events"] if e.get("cat") == "note"]
+    assert notes and notes[0]["name"] == "drain_started"
+    assert notes[0]["args"]["queued"] == 7
+
+
+def test_dump_readable_by_trace_tools(recorder, fresh_registry):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.trace2summary import load_events, summarize
+    with span("incident_phase"):
+        pass
+    p = recorder.dump("tool_check")
+    events = load_events(p)
+    assert [e["name"] for e in events] == ["incident_phase"]
+    assert summarize(events)[0]["phase"] == "incident_phase"
+
+
+def test_dump_never_raises_into_failing_path(recorder, monkeypatch):
+    monkeypatch.setattr(recorder, "directory", "/dev/null/cannot/exist")
+    assert recorder.dump("doomed") is None         # logged, not raised
+
+
+# -------------------------------------------------- trigger: NaN-loss batch
+def test_nan_loss_batch_leaves_black_box(recorder, fresh_registry, rng):
+    """Acceptance: an injected NaN-loss batch produces a dump containing
+    the preceding spans/events."""
+    from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+    from deeplearning4j_tpu.telemetry import (TrainingWatch,
+                                              set_training_watch)
+    net = _tiny_net()
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=32)]
+    x[18] = np.nan                                 # the poisoned batch
+    watch = TrainingWatch(window=4, flight_recorder=recorder,
+                          registry=fresh_registry)
+    prev = set_training_watch(watch)
+    try:
+        net.fit(iterator=ListDataSetIterator(features=x, labels=y,
+                                             batch_size=4),
+                epochs=1, async_prefetch=False)
+        assert watch.drain()
+    finally:
+        set_training_watch(prev)
+    assert not watch.healthy
+    assert watch.unhealthy[0]["reason"] == "nonfinite"
+    dump = json.load(open(recorder.last_dump_path))
+    assert dump["trigger"] == "training_nonfinite"
+    assert dump["info"]["iteration"] == watch.unhealthy[0]["iteration"]
+    # the black box holds the spans that led up to the blow-up: the
+    # already-CLOSED step spans (whether fit/epoch appear depends on
+    # whether the watch worker ran mid-fit or after — they close last)
+    span_names = {e["name"] for e in dump["events"]
+                  if e.get("cat") == "span"}
+    assert "step" in span_names
+
+
+# ----------------------------- triggers: KillWorker fault + preemption
+def test_killworker_and_preemption_leave_black_boxes(recorder,
+                                                     fresh_registry,
+                                                     tmp_path, rng):
+    """Acceptance: an injected KillWorker produces a dump with the
+    preceding spans/events; recovery and the (later-injected) preemption
+    each leave their own — one elastic run exercises all three
+    triggers."""
+    from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+    from deeplearning4j_tpu.parallel.faults import (FaultInjector, FaultPlan,
+                                                    KillWorker, PreemptAt)
+    net = _tiny_net(seed=5)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=64)]
+    it = ListDataSetIterator(features=x, labels=y, batch_size=8)
+    trainer = ElasticTrainer(
+        net, checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every_n_steps=4,
+        fault_injector=FaultInjector(FaultPlan(
+            KillWorker(step=5, worker=2, rejoin=True),
+            PreemptAt(step=8))))
+    trainer.fit(it, num_steps=20)
+    assert trainer.recoveries == 1
+    assert trainer.preempted
+    triggers = [json.load(open(p))["trigger"] for p in recorder.dumps]
+    assert "fault_killworker" in triggers
+    assert "elastic_recovery" in triggers
+    assert "preemption" in triggers
+    kill = json.load(open(recorder.dumps[triggers.index(
+        "fault_killworker")]))
+    assert kill["info"]["step"] == 5
+    # preceding spans: the steps already CLOSED before the kill (the
+    # enclosing fit/elastic.fit spans are still open mid-run — a black
+    # box holds what finished happening, which for a step loop is steps)
+    spans_ = [e for e in kill["events"] if e.get("cat") == "span"]
+    assert any(e["name"] == "step" for e in spans_)
+    # every one of them carries the elastic run's single trace id
+    ids = {e["args"].get("trace_id") for e in spans_}
+    assert len(ids) == 1 and None not in ids
+
+
+# ------------------------------------- trigger: generation scheduler error
+def test_generation_dispatch_failure_leaves_black_box(recorder,
+                                                      fresh_registry):
+    from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+    from deeplearning4j_tpu.serving import GenerationEngine
+    net = transformer_lm(vocab_size=23, d_model=16, n_heads=2, n_blocks=1,
+                         max_length=32, seed=9, dtype="float32",
+                         token_input=True).init()
+    eng = GenerationEngine(net, model_name="lm", block_len=8,
+                           max_seq_len=32, decode_slots=2,
+                           prefill_batches=(1,), prompt_rungs=(32,))
+    try:
+        rt = eng._get("lm")
+
+        def exploding(*a, **k):
+            raise RuntimeError("device meltdown")
+
+        rt.active_ps.run_prefill = exploding
+        ts = eng.generate([1, 2, 3], max_tokens=4, stream=True)
+        tokens, reason = ts.result(raise_on_error=False)
+        assert reason == "error"
+    finally:
+        eng.stop(drain=False, timeout=2.0)
+    deadline = time.monotonic() + 5.0
+    while recorder.last_dump_path is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    dump = json.load(open(recorder.last_dump_path))
+    assert dump["trigger"] == "generation_error"
+    assert dump["info"]["error_type"] == "RuntimeError"
+    assert dump["info"]["model"] == "lm"
+
+
+# -------------------------------------------- trigger: batcher model error
+def test_serving_dispatch_failure_leaves_black_box(recorder,
+                                                   fresh_registry):
+    from deeplearning4j_tpu.serving.batcher import ShapeBucketedBatcher
+    from deeplearning4j_tpu.serving.buckets import BucketLadder
+
+    def bad_runner(padded):
+        raise RuntimeError("XLA imploded")
+
+    b = ShapeBucketedBatcher(bad_runner, BucketLadder((4,)), (4,),
+                             batch_window_ms=0.5, name="doomed")
+    try:
+        with pytest.raises(RuntimeError, match="XLA imploded"):
+            b.submit(np.zeros((2, 4), np.float32), timeout=5.0)
+    finally:
+        b.stop(drain=False)
+    dump = json.load(open(recorder.last_dump_path))
+    assert dump["trigger"] == "serving_dispatch_error"
+    assert dump["info"]["model"] == "doomed"
+
+
+# --------------------------------------------- trigger: POST /debug/flightrec
+def test_http_debug_flightrec_route(recorder, fresh_registry):
+    import urllib.request
+    from deeplearning4j_tpu.serving import InferenceEngine, ServingHTTPServer
+    net = _tiny_net(seed=8)
+    eng = InferenceEngine(net, feature_shape=(4,), buckets=(4,),
+                          batch_window_ms=0.5)
+    srv = ServingHTTPServer(engine=eng)
+    base = f"http://127.0.0.1:{srv.start()}"
+    try:
+        req = urllib.request.Request(
+            base + "/debug/flightrec",
+            json.dumps({"operator": "why-slow"}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert os.path.exists(body["dumped"])
+        rec = json.load(open(body["dumped"]))
+        assert rec["trigger"] == "http_debug"
+        assert rec["info"]["operator"] == "why-slow"
+        # body keys colliding with dump()'s own parameters are prefixed,
+        # not bound (a {"trigger": ...} body used to TypeError mid-handler
+        # and {"force": false} silently rate-limited the explicit dump)
+        req = urllib.request.Request(
+            base + "/debug/flightrec",
+            json.dumps({"trigger": "spoof", "force": False}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        rec = json.load(open(body["dumped"]))
+        assert rec["trigger"] == "http_debug"
+        assert rec["info"]["body_trigger"] == "spoof"
+        assert rec["info"]["body_force"] is False
+    finally:
+        srv.stop()

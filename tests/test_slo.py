@@ -1,0 +1,355 @@
+"""SLO watchdogs + training-health watch (ISSUE 13 tentpole) and the
+Prometheus exposition-format conformance satellite.
+
+Pinned here:
+- multi-window error-budget burn rates from live counters/histograms,
+  breach edges firing the flight recorder + slo.* gauges, recovery
+  clearing the breach;
+- SLO section on the serving GET /metrics + conformant text dump on
+  GET /metrics/prometheus;
+- exposition-format round trip: _bucket/le histograms parse back, bucket
+  counts are cumulative/monotonic, label values escape;
+- TrainingWatch detection rules (nonfinite / grad_norm / loss_spike) and
+  the acceptance sync-freedom contract: the watch-armed steady-state fit
+  records ZERO HostSyncDetector hits on the loop thread and zero
+  steady-state recompiles;
+- RecompileDetector warnings carry span attrs + source hint (satellite).
+"""
+import json
+import logging
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import (ErrorRateSLO, FlightRecorder,
+                                          HostSyncDetector, LatencySLO,
+                                          MetricsRegistry, RecompileDetector,
+                                          SLOWatchdog, TrainingWatch,
+                                          set_slo_watchdog,
+                                          set_training_watch, span)
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = telemetry.set_registry(reg)
+    try:
+        yield reg
+    finally:
+        telemetry.set_registry(prev)
+
+
+@pytest.fixture
+def recorder(fresh_registry, tmp_path):
+    from deeplearning4j_tpu.telemetry import set_flight_recorder
+    rec = FlightRecorder(directory=str(tmp_path / "fr"), min_interval_s=0.0)
+    prev = set_flight_recorder(rec)
+    try:
+        yield rec
+    finally:
+        set_flight_recorder(prev)
+
+
+def _tiny_net(seed=12):
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    conf = (NeuralNetConfiguration(seed=seed, updater=Sgd(0.1))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------ burn rates
+def test_error_rate_burn_and_breach_edge(fresh_registry, recorder):
+    reg = fresh_registry
+    obj = ErrorRateSLO("admission", good="srv.ok", bad="srv.err",
+                       target=0.99)                    # budget = 1%
+    wd = SLOWatchdog([obj], windows=(10.0, 60.0), burn_limits=(10.0, 2.0),
+                     registry=reg, flight_recorder=recorder)
+    # healthy traffic: 1000 good, 0 bad
+    reg.counter("srv.ok").inc(1000)
+    out = wd.check(now=0.0)
+    out = wd.check(now=5.0)
+    row = out["objectives"]["admission"]
+    assert row["burn_rates"]["10s"] == 0.0
+    assert not row["breached"] and out["breached"] == []
+    # an outage: 30% of the next 100 requests fail -> burn 30x budget
+    reg.counter("srv.ok").inc(70)
+    reg.counter("srv.err").inc(30)
+    out = wd.check(now=8.0)
+    row = out["objectives"]["admission"]
+    assert row["burn_rates"]["10s"] == pytest.approx(30.0, rel=0.01)
+    assert row["breached"] and "10s" in row["breached_windows"]
+    assert out["breached"] == ["admission"]
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo.admission.breached"]["value"] == 1.0
+    assert snap["gauges"]["slo.admission.burn_rate_10s"]["value"] == \
+        pytest.approx(30.0, rel=0.01)
+    assert snap["counters"]["slo.breaches"] == 1
+    # the breach edge fired the flight recorder exactly once
+    assert len(recorder.dumps) == 1
+    dump = json.load(open(recorder.dumps[0]))
+    assert dump["trigger"] == "slo_breach_admission"
+    # recovery: healthy traffic pushes the window burn back under limit
+    reg.counter("srv.ok").inc(5000)
+    wd.check(now=30.0)
+    out = wd.check(now=40.0)           # 10s window now all-healthy
+    assert not out["objectives"]["admission"]["breached"]
+    assert reg.gauge("slo.admission.breached").value == 0.0
+    # no second dump without a new edge
+    assert len(recorder.dumps) == 1
+
+
+def test_latency_slo_reads_histogram_buckets(fresh_registry, recorder):
+    reg = fresh_registry
+    h = reg.histogram("serving.m.latency_ms")
+    obj = LatencySLO("p99_latency", "serving.m.latency_ms",
+                     threshold_ms=50.0, target=0.9)    # budget = 10%
+    wd = SLOWatchdog([obj], windows=(10.0,), burn_limits=(3.0,),
+                     registry=reg, flight_recorder=recorder)
+    for _ in range(100):
+        h.observe(5.0)                                 # all fast
+    wd.check(now=0.0)
+    out = wd.check(now=5.0)
+    assert out["objectives"]["p99_latency"]["burn_rates"]["10s"] == 0.0
+    for _ in range(50):
+        h.observe(500.0)                               # latency cliff
+    out = wd.check(now=8.0)
+    row = out["objectives"]["p99_latency"]
+    # 50 of 50 new observations over threshold -> bad_frac 1.0 / 0.1 = 10x
+    assert row["burn_rates"]["10s"] == pytest.approx(10.0, rel=0.01)
+    assert row["breached"]
+
+
+def test_watchdog_single_sample_window_cannot_breach(fresh_registry):
+    reg = fresh_registry
+    reg.counter("bad").inc(100)
+    wd = SLOWatchdog([ErrorRateSLO("x", good="good", bad="bad",
+                                   target=0.999)],
+                     windows=(10.0,), burn_limits=(1.0,), registry=reg)
+    out = wd.check(now=0.0)            # one sample: no delta, no verdict
+    assert not out["objectives"]["x"]["breached"]
+
+
+def test_watchdog_background_thread_and_duplicate_names():
+    reg = MetricsRegistry(enabled=True)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOWatchdog([ErrorRateSLO("a", good="g", bad="b"),
+                     ErrorRateSLO("a", good="g2", bad="b2")], registry=reg)
+    wd = SLOWatchdog([ErrorRateSLO("a", good="g", bad="b")], registry=reg)
+    wd.start(period_s=0.01)
+    import time
+    deadline = time.monotonic() + 5.0
+    while not wd.snapshot() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert "objectives" in wd.snapshot()
+
+
+# ----------------------------------------------------- /metrics surfacing
+def test_http_metrics_carries_slo_and_prometheus_route(fresh_registry,
+                                                       recorder):
+    import urllib.request
+    from deeplearning4j_tpu.serving import InferenceEngine, ServingHTTPServer
+    net = _tiny_net(seed=21)
+    eng = InferenceEngine(net, feature_shape=(4,), buckets=(4,),
+                          batch_window_ms=0.5)
+    wd = SLOWatchdog([LatencySLO("predict", "serving.default.latency_ms",
+                                 threshold_ms=1000.0, target=0.99)],
+                     registry=fresh_registry, flight_recorder=recorder)
+    prev = set_slo_watchdog(wd)
+    srv = ServingHTTPServer(engine=eng)
+    base = f"http://127.0.0.1:{srv.start()}"
+    try:
+        x = np.random.default_rng(3).normal(size=(2, 4)).astype(np.float32)
+        eng.predict(x)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            m = json.loads(r.read())
+        assert "predict" in m["slo"]["objectives"]
+        assert "burn_rates" in m["slo"]["objectives"]["predict"]
+        with urllib.request.urlopen(base + "/metrics/prometheus",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "dl4j_tpu_slo_predict_breached 0.0" in text
+        assert re.search(
+            r'dl4j_tpu_serving_default_latency_ms_bucket\{le="\+Inf"\} \d+',
+            text)
+    finally:
+        srv.stop()
+        set_slo_watchdog(prev)
+
+
+# ------------------------------------------- exposition-format round trip
+def _parse_prometheus(text):
+    """Minimal exposition-format parser for the round-trip test: returns
+    {metric: {(labelset): value}} and {metric: type}."""
+    values, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE"):
+            _, _, name, typ = line.split()
+            types[name] = typ
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+                     r'(?:\{(.*)\})?\s+(\S+)$', line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, val = m.group(1), m.group(2) or "", m.group(3)
+        parsed = ()
+        if labels:
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels):
+                key, raw = part
+                unescaped = (raw.replace("\\n", "\n").replace('\\"', '"')
+                             .replace("\\\\", "\\"))
+                parsed += ((key, unescaped),)
+        values.setdefault(name, {})[parsed] = float(val)
+    return values, types
+
+
+def test_prometheus_round_trip_conformance(fresh_registry):
+    reg = fresh_registry
+    reg.counter("train.iterations").inc(42)
+    reg.gauge("queue.depth").set(3.5)
+    h = reg.histogram("lat_ms")
+    for v in (0.2, 0.7, 3.0, 30.0, 77.0, 1e5):
+        h.observe(v)
+    values, types = _parse_prometheus(reg.to_prometheus_text())
+    assert types["dl4j_tpu_train_iterations"] == "counter"
+    assert types["dl4j_tpu_lat_ms"] == "histogram"
+    assert values["dl4j_tpu_train_iterations"][()] == 42
+    buckets = values["dl4j_tpu_lat_ms_bucket"]
+    # cumulative + monotone nondecreasing in le order, +Inf == count
+    by_le = {dict(k)["le"]: v for k, v in buckets.items()}
+    bounds = [le for le in by_le if le != "+Inf"]
+    ordered = sorted(bounds, key=float)
+    counts = [by_le[le] for le in ordered]
+    assert counts == sorted(counts)
+    assert by_le["+Inf"] == values["dl4j_tpu_lat_ms_count"][()] == 6
+    assert by_le["0.5"] == 1 and by_le["1"] == 2 and by_le["50"] == 4
+    assert values["dl4j_tpu_lat_ms_sum"][()] == pytest.approx(h.sum)
+    # exact threshold accounting the SLO layer relies on
+    assert h.count_le(50.0) == 4
+    assert h.count_le(1e9) == 6
+
+
+def test_prometheus_label_escaping():
+    from deeplearning4j_tpu.telemetry.registry import escape_label_value
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    # parses back through the round-trip parser
+    line = f'm{{k="{escape_label_value(chr(34) + "x" + chr(92))}"}} 1'
+    values, _ = _parse_prometheus("# TYPE m gauge\n" + line)
+    assert dict(list(values["m"].keys())[0])["k"] == '"x\\'
+
+
+# ------------------------------------------------------- training watch
+def _health(loss, gsq, nonfin):
+    return np.array([loss, gsq, nonfin], np.float32)
+
+
+def test_training_watch_detection_rules(fresh_registry, recorder):
+    w = TrainingWatch(window=1, grad_norm_limit=10.0, loss_spike_factor=5.0,
+                      registry=fresh_registry, flight_recorder=recorder)
+    for it in range(6):
+        w.on_health(it, _health(1.0, 4.0, 0))          # healthy history
+    assert w.drain() and w.healthy
+    w.on_health(6, _health(1.0, 400.0, 0))             # |g| = 20 > 10
+    w.on_health(7, _health(50.0, 4.0, 0))              # 50 > 5 * median(1)
+    w.on_health(8, _health(float("nan"), 4.0, 2))      # nonfinite
+    assert w.drain()
+    reasons = [u["reason"] for u in w.unhealthy]
+    assert reasons == ["grad_norm", "loss_spike", "nonfinite"]
+    assert w.unhealthy[0]["iteration"] == 6
+    snap = fresh_registry.snapshot()
+    assert snap["counters"]["training_watch.unhealthy"] == 3
+    assert snap["counters"]["training_watch.unhealthy.nonfinite"] == 1
+    assert snap["gauges"]["training_watch.healthy"]["value"] == 0.0
+    assert recorder.dumps                       # evidence shipped
+    w.close()
+
+
+def test_training_watch_window_boundary_flush(fresh_registry):
+    w = TrainingWatch(window=8, loss_spike_factor=None,
+                      registry=fresh_registry)
+    for it in range(7):
+        w.on_health(it, _health(1.0, 1.0, 0))
+    assert w._buffered == 7                     # below window: buffered
+    w.on_health(7, _health(1.0, 1.0, 0))
+    assert w._buffered == 0                     # boundary: handed off
+    # fused windows count k steps at once
+    w.on_health(8, np.ones((8, 3), np.float32), k=8)
+    assert w._buffered == 0
+    assert w.drain()
+    assert w.steps_seen == 16
+    w.close()
+
+
+def test_training_health_vec_in_program():
+    from deeplearning4j_tpu.telemetry.slo import training_health_vec
+    grads = {"w": jnp.array([3.0, 4.0]), "b": jnp.array([jnp.inf])}
+    v = np.asarray(jax.jit(training_health_vec)(jnp.float32(2.5), grads))
+    assert v[0] == 2.5
+    assert not np.isfinite(v[1])               # inf**2 rides the norm
+    assert v[2] == 1                           # one nonfinite grad value
+    clean = {"w": jnp.array([3.0, 4.0])}
+    v = np.asarray(training_health_vec(jnp.float32(1.0), clean))
+    assert v[1] == pytest.approx(25.0) and v[2] == 0
+
+
+# --------------------------------------- acceptance: sync-free + no retrace
+def test_watch_armed_fit_sync_free_and_zero_recompiles(fresh_registry, rng):
+    """Acceptance: the watch-armed steady-state fit records ZERO
+    HostSyncDetector hits on the loop thread and zero steady-state
+    recompiles — in per-step AND fused-window mode (the health vector
+    rides the program; materialization happens on the watch's worker)."""
+    from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=32)]
+
+    def it():
+        return ListDataSetIterator(features=x, labels=y, batch_size=8)
+
+    for k in (1, 2):
+        net = _tiny_net()
+        watch = TrainingWatch(window=2, registry=fresh_registry)
+        prev = set_training_watch(watch)
+        try:
+            # warm-up epoch compiles the health-carrying program
+            net.fit(iterator=it(), epochs=1, steps_per_dispatch=k,
+                    async_prefetch=False)
+            with HostSyncDetector(action="count") as sync_det, \
+                    RecompileDetector(allowed=0, warn=False) as comp_det:
+                net.fit(iterator=it(), epochs=1, steps_per_dispatch=k,
+                        async_prefetch=False)
+            assert watch.drain()
+            assert sync_det.count == 0, \
+                f"K={k}: syncs at " \
+                f"{[e['span_path'] for e in sync_det.events]}"
+            assert comp_det.count == 0, f"K={k}: {comp_det.events}"
+            assert watch.steps_seen == 8    # second fit's 4 steps/epoch x2
+        finally:
+            set_training_watch(prev)
+            watch.close()
+
+
+# --------------------------------------- RecompileDetector enrichment (sat)
+def test_recompile_warning_carries_span_attrs_and_source(fresh_registry,
+                                                         caplog):
+    f = jax.jit(lambda a: (a * 2.0).sum())
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        with RecompileDetector(allowed=0) as det:
+            with span("decode_loop", model="lm", iteration=14):
+                f(jnp.ones((7,), jnp.float32))     # fresh shape: retrace
+    assert det.count >= 1
+    ev = det.events[0]
+    assert ev["span_attrs"]["model"] == "lm"
+    assert ev["span_attrs"]["iteration"] == 14
+    assert "test_slo.py" in ev["source"]           # this file drove it
+    msg = "\n".join(r.message for r in caplog.records)
+    assert "model" in msg and "test_slo.py" in msg

@@ -1,11 +1,15 @@
 #!/usr/bin/env python
 """Fold a telemetry Chrome-trace JSONL into a per-phase time table.
 
-    python tools/trace2summary.py trace.json [--by-path] [--top N]
+    python tools/trace2summary.py trace.json[.gz] [--by-path] [--top N]
+                                  [--trace-id ID]
 
 Reads the trace written by ``telemetry.MetricsRegistry.write_chrome_trace``
 (one event per line inside a JSON array; bare JSONL — one object per line,
-no brackets — is accepted too) and prints per-phase totals:
+no brackets — is accepted too; gzipped files and flight-recorder dumps —
+the ``{"flightrec": 1, "events": [...]}`` shape — are unwrapped
+transparently; ``--trace-id`` keeps only one request's events) and prints
+per-phase totals:
 
     phase                           count    total_ms     mean_ms      p95_ms  share
     fit/epoch/window/dispatch          32      412.10       12.88       14.02  61.3%
@@ -22,20 +26,37 @@ retrace-heavy run shows its compile tax as a phase.
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+
+def _read_text(path: str) -> str:
+    """Plain or gzipped (by .gz suffix OR magic bytes — rotated logs are
+    often compressed without a rename)."""
+    with open(path, "rb") as f:
+        magic = f.read(2)
+    if path.endswith(".gz") or magic == b"\x1f\x8b":
+        with gzip.open(path, "rt") as f:
+            return f.read()
+    with open(path) as f:
+        return f.read()
 
 
 def load_events(path: str) -> List[dict]:
-    """Chrome-trace JSON array OR bare JSONL (one event object per line)."""
-    with open(path) as f:
-        text = f.read()
+    """Chrome-trace JSON array, bare JSONL (one event object per line),
+    or a flight-recorder dump (its ``events`` array is extracted) —
+    gzipped or not."""
+    text = _read_text(path)
     stripped = text.strip()
     if not stripped:
         return []
     try:
         data = json.loads(stripped)
+        if isinstance(data, dict):
+            # a flight-recorder black box carries its ring under "events"
+            return list(data.get("events", [data]))
         return data if isinstance(data, list) else [data]
     except json.JSONDecodeError:
         events = []
@@ -45,6 +66,16 @@ def load_events(path: str) -> List[dict]:
                 continue
             events.append(json.loads(line))
         return events
+
+
+def filter_trace_id(events: List[dict],
+                    trace_id: Optional[str]) -> List[dict]:
+    """Keep only one request's events (matched on ``args.trace_id``)."""
+    if not trace_id:
+        return events
+    want = trace_id.strip().lower().replace("-", "")
+    return [e for e in events
+            if e.get("args", {}).get("trace_id") == want]
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -130,11 +161,14 @@ def main(argv=None) -> int:
                        const="name", help="group by span name only")
     ap.add_argument("--top", type=int, default=0,
                     help="show only the N largest phases")
+    ap.add_argument("--trace-id", default=None,
+                    help="fold only the events of one request's trace id")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
 
-    rows = summarize(load_events(args.trace), by=args.by)
+    rows = summarize(filter_trace_id(load_events(args.trace),
+                                     args.trace_id), by=args.by)
     if args.top:
         rows = rows[:args.top]
     if args.json:

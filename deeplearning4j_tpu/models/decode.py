@@ -58,6 +58,23 @@ class KVStore(Protocol):
         ...
 
 
+def window_attention(q, k, v, row_mask):
+    """Row-masked softmax attention for a W-token decode window:
+    q [B,H,W,Dh], k/v [B,H,L,Dh], row_mask [B,W,L] (True = visible).
+
+    Mirrors ``parallel.ring_attention.attention``'s arithmetic EXACTLY
+    (same scale cast, same -1e30 fill, same softmax axis) but with a
+    per-query-row key mask — row ``i`` seeing keys ``<= pos+i`` computes
+    the very numbers the one-token ``attention(..., key_mask=)`` row
+    computes, which is what keeps a batched speculative verify
+    token-for-token identical to W sequential decode steps."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * jnp.asarray(scale, q.dtype)
+    s = jnp.where(row_mask[:, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
 # ---------------------------------------------------------------- transformer
 class TransformerDecodeSpec:
     """Vertex map of a ``models.transformer_lm`` graph, validated for the
@@ -210,6 +227,53 @@ class TransformerDecodeSpec:
                         self._apply(params, state, f"b{i}_ff1", y2))
         return h2 + f                                          # b{i}_add2
 
+    # --------------------------------------------------------- decode window
+    def decode_window(self, params, state, tokens, pos, store):
+        """W tokens per sequence in ONE pass — the speculative-verify
+        forward. ``tokens`` [B,W] are fed at positions ``pos .. pos+W-1``;
+        ``store`` is a window store (``put_get`` takes [B,W,H,Dh] and
+        returns per-row key masks). Every op is the [B,W,·] batched form of
+        the exact per-position ``decode_step`` math (all non-attention ops
+        are position-wise; attention rows carry per-row masks), so the
+        returned logits [B,W,V] match W sequential decode steps
+        token-for-token — the property the verify acceptance rule needs."""
+        B, W = tokens.shape
+        x = self.embed_tokens(params, tokens)                  # [B,W,d]
+        P = self._p(params, "pos")["P"]
+        w_pos = pos[:, None] + jnp.arange(W)[None, :]          # [B,W]
+        x = x + P[jnp.clip(w_pos, 0, P.shape[0] - 1)]
+        pos_layer = self._v["pos"].layer_conf
+        x = pos_layer.act(x)
+        for i in range(self.n_blocks):
+            x = self._block_window(params, state, i, x, store)
+        y = self._apply(params, state, "ln_f", x)
+        head_v = self._v["head"]
+        if head_v.preprocessor is not None:
+            y = head_v.preprocessor.apply(y)
+        return head_v.layer_conf.pre_output(self._p(params, "head"), y)
+
+    def _block_window(self, params, state, i, x, store):
+        h = x
+        y = self._apply(params, state, f"b{i}_ln1", x)         # [B,W,d]
+        ap = self._p(params, f"b{i}_attn")
+        attn_layer = self._v[f"b{i}_attn"].layer_conf
+        B, W, _ = y.shape
+        q = self._heads(y @ ap["Wq"])                          # [B,H,W,Dh]
+        k_win = (y @ ap["Wk"]).reshape(B, W, self.n_heads, self.head_dim)
+        v_win = (y @ ap["Wv"]).reshape(B, W, self.n_heads, self.head_dim)
+        K, V, row_mask = store.put_get(i, k_win, v_win)
+        out = window_attention(q, K, V, row_mask)
+        out = out.transpose(0, 2, 1, 3).reshape(B, W, self.d_model)
+        if attn_layer.project_out:
+            out = out @ ap["Wo"] + ap["b"]
+        out = attn_layer.act(out)
+        x = h + out
+        h2 = x
+        y2 = self._apply(params, state, f"b{i}_ln2", x)
+        f = self._apply(params, state, f"b{i}_ff2",
+                        self._apply(params, state, f"b{i}_ff1", y2))
+        return h2 + f
+
 
 # ----------------------------------------------------------------------- LSTM
 class LSTMDecodeSpec:
@@ -288,6 +352,35 @@ class LSTMDecodeSpec:
         (states, logits), _ = jax.lax.scan(step, (rnn_states, logits0),
                                            jnp.arange(L))
         return logits, states
+
+
+# ------------------------------------------------------------- draft builder
+def truncated_draft(net, n_blocks: int = 1):
+    """Build a speculative-decoding draft by TRUNCATING a
+    ``models.transformer_lm`` target: same embed/pos/head (and the first
+    ``n_blocks`` transformer blocks) with the target's own weights, fewer
+    blocks. A well-trained deep LM's later blocks refine a prediction the
+    early blocks already carry, so the truncation is the zero-extra-training
+    draft — where that residual refinement is small, greedy agreement (and
+    so accepted tokens per verify) is high.
+
+    Returns a fresh ComputationGraph sharing no mutable state with the
+    target (params copied by vertex NAME, jnp arrays are immutable)."""
+    from .zoo_extra import transformer_lm
+
+    spec = TransformerDecodeSpec(net)
+    if not 1 <= n_blocks <= spec.n_blocks:
+        raise ValueError(f"draft n_blocks must be in 1..{spec.n_blocks}, "
+                         f"got {n_blocks}")
+    draft = transformer_lm(vocab_size=spec.vocab, d_model=spec.d_model,
+                           n_heads=spec.n_heads, n_blocks=n_blocks,
+                           max_length=spec.max_length,
+                           dtype=str(net.conf.dtype),
+                           token_input=spec.token_input).init()
+    src = {n: p for n, p in zip(net.vertex_names, net.params)}
+    draft.params = tuple(
+        src.get(n, p) for n, p in zip(draft.vertex_names, draft.params))
+    return draft
 
 
 # ------------------------------------------------------------ naive reference

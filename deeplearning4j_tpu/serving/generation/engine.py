@@ -30,7 +30,7 @@ class GenerationEngine:
     def __init__(self, net=None, *, model_name: str = "default",
                  config: Optional[GenerationConfig] = None,
                  adapter: str = "auto", warm: bool = True,
-                 watch_recompiles: bool = True, **config_kwargs):
+                 watch_recompiles: bool = True, draft=None, **config_kwargs):
         self._models: Dict[str, ModelRuntime] = {}
         self._default: Optional[str] = None
         self._lock = threading.Lock()
@@ -39,13 +39,20 @@ class GenerationEngine:
         self._watch = watch_recompiles
         if net is not None:
             self.add_model(model_name, net, config=config, adapter=adapter,
-                           warm=warm, default=True, **config_kwargs)
+                           warm=warm, default=True, draft=draft,
+                           **config_kwargs)
 
     # ------------------------------------------------------------------ models
     def add_model(self, name: str, net, *,
                   config: Optional[GenerationConfig] = None,
                   adapter: str = "auto", warm: bool = True,
-                  default: bool = False, **config_kwargs) -> ModelRuntime:
+                  default: bool = False, draft=None,
+                  **config_kwargs) -> ModelRuntime:
+        """Register a generation model. Per-model opt-ins (ISSUE 14):
+        ``draft=`` attaches a speculative-decoding draft model (the
+        config's ``spec_k`` proposals per verify window, default 4);
+        ``prefix_cache=`` (config/kwarg) disables or forces prompt-prefix
+        KV sharing (default: on for paged-transformer models)."""
         with self._lock:
             if name in self._models:
                 raise ValueError(f"generation model '{name}' already "
@@ -54,6 +61,7 @@ class GenerationEngine:
         self._pause_detectors()
         try:
             ps = GenerationProgramSet(net, config=cfg, adapter=adapter,
+                                      draft_net=draft,
                                       trace_hook=self._on_trace)
             if warm:
                 ps.warm()
@@ -101,13 +109,17 @@ class GenerationEngine:
                  max_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  stop: Sequence[int] = (),
-                 timeout: Optional[float] = None, stream: bool = False
+                 timeout: Optional[float] = None, stream: bool = False,
+                 speculative: bool = True
                  ) -> Union[TokenStream, Tuple[list, str]]:
         """Generate up to ``max_tokens`` tokens after ``prompt`` (a 1-D int
         token-id sequence). ``stream=True`` returns a TokenStream to
         iterate; otherwise blocks and returns (tokens, finish_reason).
         ``temperature<=0`` is greedy; ``top_k<=0`` disables the top-k cut;
-        ``stop`` token ids terminate generation (not emitted)."""
+        ``stop`` token ids terminate generation (not emitted);
+        ``speculative=False`` opts this request out of draft-verify decode
+        on a speculating model (sampling requests opt out automatically —
+        the exact-output guarantee is greedy-only)."""
         if self._draining:
             raise DrainingError("generation engine is draining")
         rt = self._get(model)
@@ -115,31 +127,34 @@ class GenerationEngine:
                        max_new=(max_tokens if max_tokens is not None
                                 else rt.config.default_max_tokens),
                        temperature=temperature, top_k=top_k, stop=stop,
-                       timeout=timeout)
+                       timeout=timeout, speculative=speculative)
         if stream:
             return ts
         return ts.result()
 
     # --------------------------------------------------------------- hot-swap
-    def hot_swap(self, name: str, net_or_path) -> int:
+    def hot_swap(self, name: str, net_or_path, draft=None) -> int:
         """Replace model ``name`` with zero downtime. Cutover rule:
-        generations in flight at swap time FINISH on the old params (their
-        cohort keeps its program set and cache pool until it drains); every
-        admission after the swap runs the new params. Same-architecture
-        swaps reuse the compiled executables; changed architectures warm a
-        full new program set BEFORE the cutover. Returns the new version."""
+        generations in flight at swap time FINISH on the old params AND the
+        old draft (their cohort keeps its program set, cache pool, prefix
+        cache and draft cache until it drains); every admission after the
+        swap runs the new params. Same-architecture swaps reuse the
+        compiled executables (the draft carries over unless a new one is
+        given); changed architectures warm a full new program set BEFORE
+        the cutover. Returns the new version."""
         rt = self._get(name)
         net = load_net(net_or_path) if isinstance(net_or_path, str) \
             else net_or_path
         with rt.swap_lock:
             old = rt.active_ps
             try:
-                new_ps = old.with_params_from(net)
+                new_ps = old.with_params_from(net, draft_net=draft)
             except ValueError:
                 self._pause_detectors()
                 try:
                     new_ps = GenerationProgramSet(
                         net, config=old.config, adapter="auto",
+                        draft_net=draft or old.draft_net,
                         trace_hook=self._on_trace).warm()
                 finally:
                     self._resume_detectors()
@@ -172,6 +187,12 @@ class GenerationEngine:
             "prefill_batches": list(rt.config.prefill_batches),
             "in_flight": rt.in_flight,
             "queue_depth": rt.queue_depth,
+            "prefix_cache": rt.active_ps.prefix_enabled,
+            "speculative": {
+                "enabled": rt.active_ps.spec_k > 0,
+                "k": rt.active_ps.spec_k,
+                "draft_adapter": rt.active_ps.draft_adapter,
+            },
         } for rt in rts}
 
     def queue_depths(self) -> Dict[str, int]:

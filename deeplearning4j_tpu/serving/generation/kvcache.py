@@ -33,13 +33,22 @@ from ..errors import BlockPoolExhaustedError
 class BlockAllocator:
     """Free-list allocator over the pool's usable blocks (ids 1..n-1; block
     0 is the trash block). Not thread-safe by itself — the scheduler owns
-    it from its single dispatch thread."""
+    it from its single dispatch thread.
+
+    Hardened bookkeeping (ISSUE 14): an explicit allocated set plus
+    per-block refcounts (the prefix cache's sharing currency). Freeing a
+    block that was never allocated, double-freeing, or freeing a block
+    whose refcount is still nonzero all raise — a leak or double-free
+    corrupts EVERY sequence sharing the pool, so it must die loudly at the
+    first bad call, not surface later as silently-wrong tokens."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved trash)")
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._allocated: set = set()
+        self._refcount: dict = {}
 
     @property
     def total_usable(self) -> int:
@@ -53,21 +62,56 @@ class BlockAllocator:
     def used_blocks(self) -> int:
         return self.total_usable - len(self._free)
 
+    @property
+    def allocated(self) -> frozenset:
+        return frozenset(self._allocated)
+
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
             raise BlockPoolExhaustedError(
                 f"block pool exhausted: need {n} blocks, "
                 f"{len(self._free)}/{self.total_usable} free — retry after "
                 f"in-flight generations release their blocks")
-        return [self._free.pop() for _ in range(n)]
+        got = [self._free.pop() for _ in range(n)]
+        self._allocated.update(got)
+        return got
 
     def free(self, ids: Sequence[int]) -> None:
         for b in ids:
             if not 1 <= b < self.num_blocks:
                 raise ValueError(f"free of invalid block id {b}")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
+            if b not in self._allocated:
+                raise ValueError(
+                    f"free of unallocated block {b} (double free, or an id "
+                    f"this allocator never handed out)")
+            if self._refcount.get(b, 0):
+                raise ValueError(
+                    f"free of block {b} with refcount "
+                    f"{self._refcount[b]} — shared blocks must be "
+                    f"released through the prefix cache, not freed")
+            self._allocated.discard(b)
             self._free.append(int(b))
+
+    # ------------------------------------------------------------ refcounts
+    def incref(self, b: int) -> int:
+        if b not in self._allocated:
+            raise ValueError(f"incref of unallocated block {b}")
+        self._refcount[b] = self._refcount.get(b, 0) + 1
+        return self._refcount[b]
+
+    def decref(self, b: int) -> int:
+        n = self._refcount.get(b, 0)
+        if n < 1:
+            raise ValueError(f"decref of block {b} below zero")
+        n -= 1
+        if n:
+            self._refcount[b] = n
+        else:
+            del self._refcount[b]
+        return n
+
+    def refcount(self, b: int) -> int:
+        return self._refcount.get(b, 0)
 
 
 def make_pools(n_layers: int, num_blocks: int, block_len: int,
@@ -75,6 +119,17 @@ def make_pools(n_layers: int, num_blocks: int, block_len: int,
     """Zero-filled (k_pool, v_pool)."""
     shape = (n_layers, num_blocks, block_len, n_heads, head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def cow_copy(k_pool, v_pool, src, dst):
+    """Copy one block's content (every layer, K and V) from ``src`` to
+    ``dst`` — the copy-on-write primitive for prefix sharing. ``src``/
+    ``dst`` are runtime int32 scalars, so ONE compiled program serves every
+    copy; functional update keeps the read-before-write ordering a data
+    dependency."""
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+    return k_pool, v_pool
 
 
 def prefill_scatter(pool, layer_kv, tables):
@@ -122,6 +177,55 @@ class PagedStore:
         self.k_pool = self.k_pool.at[i, self._bid, self._off].set(k_tok)
         self.v_pool = self.v_pool.at[i, self._bid, self._off].set(v_tok)
         H, Dh = k_tok.shape[-2:]
+
+        def gathered(pool):
+            ctx = pool[i][self.tables]          # [S, mb, blk, H, Dh]
+            return ctx.reshape(S, self._ctx_len, H, Dh).transpose(0, 2, 1, 3)
+
+        return gathered(self.k_pool), gathered(self.v_pool), self._mask
+
+    @property
+    def pools(self):
+        return self.k_pool, self.v_pool
+
+
+class PagedWindowStore:
+    """``models.decode`` window store over the paged pools for ONE
+    speculative-verify pass: W = k+1 fed tokens per slot land at positions
+    ``pos .. pos+W-1`` (crossing block boundaries via per-position
+    (block, offset) indices), then the gathered context plus per-row key
+    masks reproduce, row by row, exactly the visibility the one-token
+    ``PagedStore`` gives position ``pos+i`` — which is what makes the
+    batched verify bit-identical to W sequential decode steps."""
+
+    def __init__(self, k_pool, v_pool, tables, pos, active, block_len: int,
+                 window: int):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.tables = tables              # [S, max_blocks] int32
+        self.block_len = int(block_len)
+        S, mb = tables.shape
+        self._ctx_len = mb * self.block_len
+        w_pos = pos[:, None] + jnp.arange(window)[None, :]       # [S, W]
+        bidx = jnp.clip(w_pos // self.block_len, 0, mb - 1)
+        bid = jnp.take_along_axis(tables, bidx, axis=1)          # [S, W]
+        # idle slots AND window positions past capacity (a verify window is
+        # always W wide even when < W tokens of budget remain) go to trash —
+        # a clipped in-range write would corrupt the last real block
+        ok = active[:, None] & (w_pos < mb * self.block_len)
+        self._bid = jnp.where(ok, bid, 0)
+        self._off = jnp.where(ok, w_pos % self.block_len, 0)
+        # row i of a slot's mask: keys at positions <= pos+i are visible
+        self._mask = (jnp.arange(self._ctx_len)[None, None, :]
+                      <= w_pos[:, :, None])                      # [S, W, ctx]
+
+    def put_get(self, i: int, k_win, v_win):
+        """k_win/v_win: [S, W, H, Dh] for the window. Returns
+        (K [S,H,ctx,Dh], V [S,H,ctx,Dh], row_mask [S,W,ctx])."""
+        S = k_win.shape[0]
+        self.k_pool = self.k_pool.at[i, self._bid, self._off].set(k_win)
+        self.v_pool = self.v_pool.at[i, self._bid, self._off].set(v_win)
+        H, Dh = k_win.shape[-2:]
 
         def gathered(pool):
             ctx = pool[i][self.tables]          # [S, mb, blk, H, Dh]

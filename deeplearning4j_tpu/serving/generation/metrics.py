@@ -43,6 +43,21 @@ class GenerationMetrics:
         self.decode_recompiles = 0
         self.slots = 0
         self.blocks_total = 0
+        # prefix-cache economics (ISSUE 14)
+        self._ttft_cached_ms = deque(maxlen=window)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
+        self.cow_copies = 0
+        self.prefix_evictions = 0
+        self._prefix_gauges: dict = {}
+        # speculative decoding
+        self._verify_ms = deque(maxlen=window)
+        self.verify_steps = 0
+        self.verify_slot_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         self._t0 = time.monotonic()
         self._rate_t = self._t0
 
@@ -108,6 +123,116 @@ class GenerationMetrics:
                 reg.gauge(f"generation.{self.name}.tokens_per_sec").set(
                     self._recent_tokens_per_sec(now))
 
+    # -------------------------------------------------- prefix cache (hits)
+    def record_prefix_hit(self, tokens_saved: int) -> None:
+        with self._lock:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += tokens_saved
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.prefix.hits").inc()
+            if tokens_saved:
+                reg.counter(
+                    f"generation.{self.name}.prefix.tokens_saved").inc(
+                    tokens_saved)
+            self._hit_rate_gauge(reg)
+
+    def record_prefix_miss(self) -> None:
+        with self._lock:
+            self.prefix_misses += 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.prefix.misses").inc()
+            self._hit_rate_gauge(reg)
+
+    def _hit_rate_gauge(self, reg) -> None:
+        total = self.prefix_hits + self.prefix_misses
+        if total:
+            reg.gauge(f"generation.{self.name}.prefix_hit_rate").set(
+                round(self.prefix_hits / total, 4))
+
+    def record_cow(self) -> None:
+        with self._lock:
+            self.cow_copies += 1
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.prefix.cow_copies").inc()
+
+    def record_prefix_evictions(self, n: int) -> None:
+        with self._lock:
+            self.prefix_evictions += n
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.prefix.evictions").inc(n)
+
+    def record_cached_first_token(self, ttft_ms: float) -> None:
+        """TTFT for a cache-hit admission (prefill skipped; first token
+        fell out of the replay's final decode step)."""
+        with self._lock:
+            self._ttft_cached_ms.append(ttft_ms)
+        reg = self.registry
+        if reg.enabled:
+            reg.histogram(
+                f"generation.{self.name}.ttft_cached_ms").observe(ttft_ms)
+
+    def set_prefix_gauges(self, stats: dict) -> None:
+        """Mirror the active cohort's block-pool economics (shared blocks,
+        cached-LRU size) — the /metrics 'prefix' gauges."""
+        with self._lock:
+            self._prefix_gauges = dict(stats)
+        reg = self.registry
+        if reg.enabled:
+            reg.gauge(f"generation.{self.name}.prefix.shared_blocks").set(
+                stats.get("shared_blocks", 0))
+            reg.gauge(
+                f"generation.{self.name}.prefix.cached_lru_blocks").set(
+                stats.get("cached_lru_blocks", 0))
+
+    # ------------------------------------------------- speculative decoding
+    def record_verify(self, step_ms: float, active_slots: int, *,
+                      proposed: int, accepted: int, emitted: int,
+                      slots: int, blocks_used: int, blocks_total: int,
+                      queue_depth: int) -> None:
+        """One draft-propose + verify window: ``accepted`` draft tokens
+        matched the target's greedy choice; ``emitted`` includes each
+        slot's correction token (the per-target-dispatch yield)."""
+        now = time.monotonic()
+        with self._lock:
+            self.verify_steps += 1
+            self.verify_slot_steps += active_slots
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            self.spec_emitted += emitted
+            self.tokens_out += emitted
+            # verify windows are k+1-token passes — kept OUT of the
+            # one-token decode_step_ms population (own percentiles below)
+            self._verify_ms.append(step_ms)
+            self._tok_t.extend([now] * emitted)
+            self.slots = slots
+            self.blocks_total = blocks_total
+            per_verify = (self.spec_emitted / self.verify_slot_steps
+                          if self.verify_slot_steps else 0.0)
+        reg = self.registry
+        if reg.enabled:
+            reg.counter(f"generation.{self.name}.spec.verify_steps").inc()
+            reg.counter(f"generation.{self.name}.spec.proposed").inc(proposed)
+            reg.counter(f"generation.{self.name}.spec.accepted").inc(accepted)
+            reg.counter(f"generation.{self.name}.tokens_out").inc(emitted)
+            reg.histogram(
+                f"generation.{self.name}.verify_step_ms").observe(step_ms)
+            reg.gauge(
+                f"generation.{self.name}.spec.accepted_per_verify").set(
+                round(per_verify, 3))
+            reg.gauge(f"generation.{self.name}.slot_occupancy").set(
+                active_slots / slots if slots else 0.0)
+            reg.gauge(f"generation.{self.name}.blocks_in_use").set(
+                blocks_used)
+            reg.gauge(f"generation.{self.name}.queue_depth").set(queue_depth)
+            if now - self._rate_t >= 0.5:
+                self._rate_t = now
+                reg.gauge(f"generation.{self.name}.tokens_per_sec").set(
+                    self._recent_tokens_per_sec(now))
+
     def record_finish(self, reason: str) -> None:
         with self._lock:
             self.finished[reason] = self.finished.get(reason, 0) + 1
@@ -159,10 +284,19 @@ class GenerationMetrics:
         now = time.monotonic()
         with self._lock:
             ttft = sorted(self._ttft_ms)
+            ttft_c = sorted(self._ttft_cached_ms)
             step = sorted(self._step_ms)
-            occ = (self.decode_slot_steps / (self.decode_steps * self.slots)
-                   if self.decode_steps and self.slots else 0.0)
-            return {
+            verify = sorted(self._verify_ms)
+            # occupancy over BOTH step kinds: a speculation-saturated
+            # engine advances slots through verify windows, not plain
+            # decode steps — counting only the latter read near-zero
+            # under full load
+            steps_all = self.decode_steps + self.verify_steps
+            occ = ((self.decode_slot_steps + self.verify_slot_steps)
+                   / (steps_all * self.slots)
+                   if steps_all and self.slots else 0.0)
+            lookups = self.prefix_hits + self.prefix_misses
+            out = {
                 "requests": self.requests,
                 "tokens_out": self.tokens_out,
                 "prefills": self.prefills,
@@ -179,7 +313,43 @@ class GenerationMetrics:
                 "hot_swaps": self.swaps,
                 "decode_recompiles": self.decode_recompiles,
                 "uptime_s": round(now - self._t0, 1),
+                # block-pool economics: who is sharing, what the cache
+                # holds, what COW and eviction cost
+                "prefix": {
+                    "hits": self.prefix_hits,
+                    "misses": self.prefix_misses,
+                    "hit_rate": (round(self.prefix_hits / lookups, 4)
+                                 if lookups else 0.0),
+                    "tokens_saved": self.prefix_tokens_saved,
+                    "cow_copies": self.cow_copies,
+                    "evictions": self.prefix_evictions,
+                    "shared_blocks": self._prefix_gauges.get(
+                        "shared_blocks", 0),
+                    "cached_lru_blocks": self._prefix_gauges.get(
+                        "cached_lru_blocks", 0),
+                    "cached_blocks": self._prefix_gauges.get(
+                        "cached_blocks", 0),
+                    "ttft_cached_ms": {
+                        "p50": round(_percentile(ttft_c, 0.50), 3),
+                        "p99": round(_percentile(ttft_c, 0.99), 3)},
+                },
+                "speculative": {
+                    "verify_steps": self.verify_steps,
+                    "verify_step_ms": {
+                        "p50": round(_percentile(verify, 0.50), 3),
+                        "p99": round(_percentile(verify, 0.99), 3)},
+                    "proposed": self.spec_proposed,
+                    "accepted": self.spec_accepted,
+                    "emitted": self.spec_emitted,
+                    "accepted_tokens_per_verify": (
+                        round(self.spec_emitted / self.verify_slot_steps, 3)
+                        if self.verify_slot_steps else 0.0),
+                    "proposals_accepted_per_verify": (
+                        round(self.spec_accepted / self.verify_slot_steps, 3)
+                        if self.verify_slot_steps else 0.0),
+                },
             }
+            return out
 
     def publish(self, storage, session_id: str = "generation",
                 worker_id: str = "default") -> dict:

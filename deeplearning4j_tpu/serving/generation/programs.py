@@ -56,10 +56,23 @@ class GenerationConfig:
     default_timeout_s: float = 30.0
     default_max_tokens: int = 32
     seed: int = 0
+    # prefix-cache sharing (ISSUE 14): None = on for the paged adapter,
+    # off for the state adapter (no blocks to share); True/False overrides
+    prefix_cache: Optional[bool] = None
+    # longest unmatched prompt suffix (tokens) a cache hit may REPLAY
+    # through the one-token decode program; a shorter match is treated as
+    # a miss — sequential replay of a long suffix would cost far more
+    # than the batched prefill it "saves". None = 2 * block_len.
+    prefix_max_replay: Optional[int] = None
+    # speculative decoding: draft proposals per verify window; 0 with a
+    # draft model attached defaults to 4 at program-set construction
+    spec_k: int = 0
 
     def __post_init__(self):
         if self.block_len < 1 or self.decode_slots < 1:
             raise ValueError("block_len and decode_slots must be >= 1")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
         self.capacity = _ceil_to(self.max_seq_len, self.block_len)
         self.blocks_per_seq = self.capacity // self.block_len
         self.prefill_batches = tuple(sorted(set(
@@ -76,6 +89,11 @@ class GenerationConfig:
             self.num_blocks = self.decode_slots * self.blocks_per_seq + 1
         if self.num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is trash)")
+        if self.prefix_max_replay is None:
+            self.prefix_max_replay = 2 * self.block_len
+        elif self.prefix_max_replay < 1:
+            raise ValueError("prefix_max_replay must be >= 1 (the final "
+                             "prompt token always replays)")
 
     @property
     def max_prompt_len(self) -> int:
@@ -111,7 +129,7 @@ class GenerationProgramSet:
     under (the hot-swap cutover rule)."""
 
     def __init__(self, net, *, config: GenerationConfig,
-                 adapter: str = "auto",
+                 adapter: str = "auto", draft_net=None,
                  trace_hook: Optional[Callable[[], None]] = None):
         self.net = net
         self.config = config
@@ -123,11 +141,45 @@ class GenerationProgramSet:
         self.state = jax.tree.map(jnp.asarray, net.state)
         self.dtype = self.spec.dtype
         self.vocab = self.spec.vocab
+        # prefix-cache sharing only exists where there are blocks to share
+        self.prefix_enabled = (self.adapter == "paged"
+                               if config.prefix_cache is None
+                               else bool(config.prefix_cache)
+                               and self.adapter == "paged")
+        # speculative decoding: active iff a draft model is attached
+        self.draft_net = draft_net
+        self.spec_k = 0
+        self.draft_adapter: Optional[str] = None
+        self.draft_spec = None
+        if draft_net is not None:
+            if self.adapter != "paged":
+                raise ValueError(
+                    "speculative decoding requires a paged (transformer) "
+                    "TARGET — the verify window runs over the block tables")
+            self.spec_k = int(config.spec_k) or 4
+            da = self._resolve_adapter(draft_net, "auto")
+            self.draft_adapter = "dense" if da == "paged" else "state"
+            self.draft_spec = (TransformerDecodeSpec(draft_net)
+                               if da == "paged" else LSTMDecodeSpec(draft_net))
+            if self.draft_spec.vocab != self.vocab:
+                raise ValueError(
+                    f"draft vocab {self.draft_spec.vocab} != target vocab "
+                    f"{self.vocab} — proposals must share the token space")
+            self.draft_params = jax.tree.map(jnp.asarray, draft_net.params)
+            self.draft_state = jax.tree.map(jnp.asarray, draft_net.state)
+            if self.draft_adapter == "state":
+                self._draft_init_states = self.draft_spec.init_states(
+                    config.decode_slots + 1)
+        draft_sig = None if draft_net is None else (
+            _tree_signature(self.draft_params),
+            _tree_signature(self.draft_state), _arch_key(draft_net),
+            self.draft_adapter, self.spec_k)
         self.signature = (_tree_signature(self.params),
                           _tree_signature(self.state), _arch_key(net),
                           self.adapter, config.block_len, config.capacity,
                           config.decode_slots, config.prefill_batches,
-                          config.prompt_rungs, config.num_blocks)
+                          config.prompt_rungs, config.num_blocks,
+                          self.prefix_enabled, draft_sig)
         self._compiled: Dict[Any, Any] = {}
         if self.adapter == "state":
             self._init_states = self.spec.init_states(config.decode_slots + 1)
@@ -159,6 +211,19 @@ class GenerationProgramSet:
 
     def fresh_key(self):
         return jax.random.PRNGKey(self.config.seed)
+
+    def make_draft_cache(self):
+        """Fresh draft cache: dense per-slot K/V for a transformer draft,
+        zeroed recurrent states (slots + 1 rows) for an LSTM draft; None
+        when speculation is off."""
+        if self.draft_adapter is None:
+            return None
+        from .speculative import make_dense_draft_cache
+        if self.draft_adapter == "dense":
+            return make_dense_draft_cache(self.draft_spec,
+                                          self.config.decode_slots,
+                                          self.config.capacity)
+        return jax.tree.map(jnp.zeros_like, self._draft_init_states)
 
     # ------------------------------------------------------------- programs
     def _prefill_fn(self):
@@ -219,9 +284,45 @@ class GenerationProgramSet:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             self.make_cache())
 
+    def _draft_cache_spec(self):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self.make_draft_cache())
+
     def _key_spec(self):
         k = self.fresh_key()
         return jax.ShapeDtypeStruct(k.shape, k.dtype)
+
+    def _cow_fn(self):
+        from .kvcache import cow_copy
+
+        def fn(cache, src, dst):
+            if self._trace_hook is not None:
+                self._trace_hook()
+            return cow_copy(cache[0], cache[1], src, dst)
+        return fn
+
+    def _spec_fns(self):
+        """(draft_prefill, propose, rewind_or_None, verify) builders."""
+        from . import speculative as sp
+        tgt, blk, k = self.spec, self.config.block_len, self.spec_k
+        hook = self._trace_hook
+
+        def hooked(f):
+            def g(*a):
+                if hook is not None:
+                    hook()
+                return f(*a)
+            return g
+
+        verify = hooked(sp.verify_fn(tgt, blk, k))
+        if self.draft_adapter == "dense":
+            return (hooked(sp.draft_prefill_dense_fn(self.draft_spec)),
+                    hooked(sp.propose_dense_fn(self.draft_spec, k)),
+                    None, verify)
+        return (hooked(sp.draft_prefill_state_fn(self.draft_spec)),
+                hooked(sp.propose_state_fn(self.draft_spec, k)),
+                hooked(sp.rewind_state_fn()), verify)
 
     # --------------------------------------------------------------- warm-up
     def warm(self) -> "GenerationProgramSet":
@@ -257,6 +358,16 @@ class GenerationProgramSet:
             key_spec,
             jax.ShapeDtypeStruct((S,), jnp.float32),
             jax.ShapeDtypeStruct((S,), i32)).compile()
+        if self.prefix_enabled:
+            # the copy-on-write block copy: src/dst are runtime scalars, so
+            # ONE executable serves every copy
+            donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+            self._compiled[("cow",)] = jax.jit(
+                self._cow_fn(), donate_argnums=donate).lower(
+                cache_spec, jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32)).compile()
+        if self.spec_k:
+            self._warm_spec(cache_spec, i32)
         # one touch per executable: first real traffic must not pay
         # dispatch-setup either
         cache, key = self.make_cache(), self.fresh_key()
@@ -271,13 +382,97 @@ class GenerationProgramSet:
             cache, np.zeros((S,), np.int32), np.zeros((S,), np.int32),
             np.zeros((S, mb), np.int32), np.zeros((S,), np.bool_), key,
             np.zeros((S,), np.float32), np.zeros((S,), np.int32))
+        if self.prefix_enabled:
+            cache = self.run_cow(cache, 0, 0)
+        if self.spec_k:
+            cache = self._touch_spec(cache)
         return self
+
+    def _warm_spec(self, cache_spec, i32):
+        """Compile the draft + verify executables (speculative decoding).
+        Cache-carrying programs donate their cache argument on TPU/GPU,
+        exactly like the decode step — the pools update in place."""
+        c = self.config
+        S, mb, k = c.decode_slots, c.blocks_per_seq, self.spec_k
+        dcache_spec = self._draft_cache_spec()
+        d_prefill, propose, rewind, verify = self._spec_fns()
+        sds = jax.ShapeDtypeStruct
+        donate = _donate_argnums()             # (2,) on tpu/gpu, () on cpu
+        for P in c.prefill_batches:
+            for L in c.prompt_rungs:
+                if self.draft_adapter == "dense":
+                    self._compiled[("draft_prefill", P, L)] = jax.jit(
+                        d_prefill, donate_argnums=donate).lower(
+                        self.draft_params, self.draft_state, dcache_spec,
+                        sds((P, L), i32), sds((P,), i32)).compile()
+                else:
+                    self._compiled[("draft_prefill", P, L)] = jax.jit(
+                        d_prefill, donate_argnums=donate).lower(
+                        self.draft_params, self.draft_state, dcache_spec,
+                        sds((P, L), i32), sds((P,), i32),
+                        sds((P,), i32)).compile()
+        if self.draft_adapter == "dense":
+            self._compiled[("propose",)] = jax.jit(
+                propose, donate_argnums=donate).lower(
+                self.draft_params, self.draft_state, dcache_spec,
+                sds((S,), i32), sds((S,), i32),
+                sds((S,), jnp.bool_)).compile()
+        else:
+            # the state propose RETURNS its input states untouched inside
+            # the stack; no donation (the scheduler still needs states_all
+            # until rewind commits)
+            self._compiled[("propose",)] = jax.jit(propose).lower(
+                self.draft_params, self.draft_state, dcache_spec,
+                sds((S,), i32)).compile()
+            stack_spec = jax.tree.map(
+                lambda a: sds((k + 1, S) + a.shape[1:], a.dtype),
+                dcache_spec)
+            rw_donate = (0,) if jax.default_backend() in ("tpu", "gpu") \
+                else ()
+            self._compiled[("rewind",)] = jax.jit(
+                rewind, donate_argnums=rw_donate).lower(
+                dcache_spec, stack_spec, sds((S,), i32),
+                sds((S,), jnp.bool_)).compile()
+        self._compiled[("verify",)] = jax.jit(
+            verify, donate_argnums=donate).lower(
+            self.params, self.state, cache_spec, sds((S, k + 1), i32),
+            sds((S,), i32), sds((S, mb), i32),
+            sds((S,), jnp.bool_)).compile()
+
+    def _touch_spec(self, cache):
+        c = self.config
+        S, mb, k = c.decode_slots, c.blocks_per_seq, self.spec_k
+        zS = np.zeros((S,), np.int32)
+        dcache = self.make_draft_cache()
+        for P in c.prefill_batches:
+            for L in c.prompt_rungs:
+                dcache = self.run_draft_prefill(
+                    dcache, np.zeros((P, L), np.int32),
+                    np.ones((P,), np.int32), np.full((P,), S, np.int32))
+        out = self.run_propose(dcache, zS, zS, np.zeros((S,), np.bool_))
+        if self.draft_adapter == "dense":
+            _, dcache = out
+        else:
+            _, stack = out
+            dcache = self.run_rewind(dcache, stack, np.ones((S,), np.int32),
+                                     np.zeros((S,), np.bool_))
+        _, cache = self.run_verify(cache, np.zeros((S, k + 1), np.int32),
+                                   zS, np.zeros((S, mb), np.int32),
+                                   np.zeros((S,), np.bool_))
+        return cache
 
     @property
     def warmed(self) -> bool:
         c = self.config
         want = {("prefill", P, L) for P in c.prefill_batches
                 for L in c.prompt_rungs} | {("decode",)}
+        if self.prefix_enabled:
+            want |= {("cow",)}
+        if self.spec_k:
+            want |= {("draft_prefill", P, L) for P in c.prefill_batches
+                     for L in c.prompt_rungs} | {("propose",), ("verify",)}
+            if self.draft_adapter == "state":
+                want |= {("rewind",)}
         return want <= set(self._compiled)
 
     # ---------------------------------------------------------------- running
@@ -308,13 +503,64 @@ class GenerationProgramSet:
                               tables, active, key, temp, topk)
         return np.asarray(tok), cache, key
 
+    def _exe(self, key):
+        exe = self._compiled.get(key)
+        if exe is None:
+            from ..errors import ServingError
+            raise ServingError(f"no warmed {key} program — call warm() "
+                               "before serving")
+        return exe
+
+    # --------------------------------------------- prefix-cache programs
+    def run_cow(self, cache, src: int, dst: int):
+        """Copy block ``src`` -> ``dst`` in both pools (copy-on-write)."""
+        return self._exe(("cow",))(cache, np.int32(src), np.int32(dst))
+
+    # --------------------------------------------- speculative programs
+    def run_draft_prefill(self, dcache, tokens, lengths, slots):
+        """Draft consumes the FULL prompt (cache-hit admissions included:
+        the draft is cheap — that is the point). Returns the draft cache."""
+        P, L = tokens.shape
+        exe = self._exe(("draft_prefill", P, L))
+        if self.draft_adapter == "dense":
+            return exe(self.draft_params, self.draft_state, dcache, tokens,
+                       slots)
+        return exe(self.draft_params, self.draft_state, dcache, tokens,
+                   lengths, slots)
+
+    def run_propose(self, dcache, cur, pos, active):
+        """Returns (proposals np [S,k], dcache') for the dense draft, or
+        (proposals np [S,k], states_stack) for the state draft (the caller
+        commits the stack through run_rewind after verify)."""
+        exe = self._exe(("propose",))
+        if self.draft_adapter == "dense":
+            props, dcache = exe(self.draft_params, self.draft_state, dcache,
+                                cur, pos, active)
+            return np.asarray(props), dcache
+        props, stack = exe(self.draft_params, self.draft_state, dcache, cur)
+        return np.asarray(props), stack
+
+    def run_rewind(self, dcache, stack, idx, mask):
+        """State-draft only: commit, per slot, the stacked state matching
+        what verify accepted (masked slots keep their state)."""
+        return self._exe(("rewind",))(dcache, stack, idx, mask)
+
+    def run_verify(self, cache, feeds, pos, tables, active):
+        """One batched target pass over [S, k+1] fed tokens. Returns
+        (greedy targets np [S,k+1], cache')."""
+        tgt, cache = self._exe(("verify",))(self.params, self.state, cache,
+                                            feeds, pos, tables, active)
+        return np.asarray(tgt), cache
+
     # --------------------------------------------------------------- hot-swap
-    def with_params_from(self, net) -> "GenerationProgramSet":
+    def with_params_from(self, net, draft_net=None) -> "GenerationProgramSet":
         """Same-architecture swap: new set sharing THIS set's executables.
-        Raises ValueError when the signature changed (caller warms a fresh
-        set before cutover)."""
+        The draft model (when speculating) carries over unless a new one is
+        given. Raises ValueError when the signature changed (caller warms a
+        fresh set before cutover)."""
         new = GenerationProgramSet(net, config=self.config,
                                    adapter=self.adapter,
+                                   draft_net=draft_net or self.draft_net,
                                    trace_hook=self._trace_hook)
         if new.signature != self.signature:
             raise ValueError("parameter/architecture changed; full warm-up "

@@ -41,6 +41,7 @@ from ..errors import (BlockPoolExhaustedError, DeadlineExceededError,
                       ShapeMismatchError)
 from .kvcache import BlockAllocator
 from .metrics import GenerationMetrics
+from .prefix import PrefixCache
 from .programs import GenerationProgramSet
 
 
@@ -103,12 +104,14 @@ class TokenStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "stop",
-                 "deadline", "stream", "slot", "blocks", "emitted",
+                 "deadline", "stream", "slot", "blocks", "shared_blocks",
+                 "replay", "replaying", "matched_tokens", "spec", "emitted",
                  "cancelled", "cancel_reason", "enqueue_t", "cohort",
                  "trace_id")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
-                 top_k: int, stop: frozenset, deadline: float):
+                 top_k: int, stop: frozenset, deadline: float,
+                 speculative: bool = True):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -118,7 +121,14 @@ class _GenRequest:
         self.stream = TokenStream()
         self.stream._cancel_cb = self._cancel
         self.slot: Optional[int] = None
-        self.blocks: List[int] = []
+        self.blocks: List[int] = []          # owned (freed at finish)
+        self.shared_blocks: List[int] = []   # cache custody (released)
+        self.replay: "deque[int]" = deque()  # prompt suffix still to feed
+        self.replaying = False
+        self.matched_tokens = 0
+        # speculative decoding is exact only for greedy requests; sampling
+        # ones ride the plain decode path
+        self.spec = bool(speculative) and temperature <= 0.0
         self.cohort = None                  # set at admission
         self.emitted = 0
         self.cancelled = False
@@ -135,9 +145,11 @@ class _GenRequest:
 
 class _Cohort:
     """In-flight sequences pinned to one program set (one model version):
-    their cache pool, block allocator and block tables live and die with
-    the cohort."""
-    __slots__ = ("ps", "cache", "allocator", "tables", "slots", "version")
+    their cache pool, block allocator, block tables, prefix cache and
+    draft cache live and die with the cohort — shared prefix K/V and draft
+    proposals can never cross a hot-swap boundary."""
+    __slots__ = ("ps", "cache", "allocator", "tables", "slots", "version",
+                 "prefix", "draft_cache")
 
     def __init__(self, ps: GenerationProgramSet, version: int):
         self.ps = ps
@@ -147,6 +159,10 @@ class _Cohort:
         S, mb = ps.config.decode_slots, ps.config.blocks_per_seq
         self.tables = np.zeros((S, mb), np.int32)
         self.slots: Set[int] = set()
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, ps.config.block_len)
+            if ps.prefix_enabled else None)
+        self.draft_cache = ps.make_draft_cache()
 
 
 class ModelRuntime:
@@ -196,7 +212,8 @@ class ModelRuntime:
 
     def submit(self, prompt, *, max_new: int, temperature: float = 0.0,
                top_k: int = 0, stop: Sequence[int] = (),
-               timeout: Optional[float] = None) -> TokenStream:
+               timeout: Optional[float] = None,
+               speculative: bool = True) -> TokenStream:
         cfg = self.config
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
@@ -224,7 +241,8 @@ class ModelRuntime:
         timeout = cfg.default_timeout_s if timeout is None else timeout
         req = _GenRequest(prompt, int(max_new), float(temperature),
                           int(top_k), frozenset(int(s) for s in stop),
-                          time.monotonic() + timeout)
+                          time.monotonic() + timeout,
+                          speculative=speculative)
         with self._cond:
             if self._draining or self._stopped:
                 self.metrics.record_rejection("draining")
@@ -234,7 +252,9 @@ class ModelRuntime:
                 cohorts = self._cohorts       # loop thread rebinds the list
                 coh = cohorts[-1] if cohorts else None
                 if self.active_ps.adapter == "paged" and coh is not None \
-                        and coh.allocator.free_blocks == 0:
+                        and coh.allocator.free_blocks == 0 \
+                        and (coh.prefix is None
+                             or coh.prefix.lru_blocks == 0):
                     self.metrics.record_rejection("exhausted")
                     raise BlockPoolExhaustedError(
                         f"model '{self.name}': KV block pool exhausted and "
@@ -282,6 +302,52 @@ class ModelRuntime:
         self._cohorts.append(coh)
         return coh
 
+    def _worth_replaying(self, matched_blocks: int, plen: int) -> bool:
+        """A cache hit replays its unmatched suffix ONE token per decode
+        dispatch — dramatically slower than a batched prefill for a long
+        suffix. Only take the hit when the suffix fits the configured
+        replay budget (``prefix_max_replay``, default 2 blocks); a shorter
+        match admits as a plain miss (and still registers its blocks)."""
+        if not matched_blocks:
+            return False
+        suffix = plen - matched_blocks * self.config.block_len
+        if suffix == 0:
+            suffix = 1                    # block-aligned: COW + one feed
+        return suffix <= self.config.prefix_max_replay
+
+    def _setup_blocks(self, coh: _Cohort, r: _GenRequest) -> None:
+        """Blocks for one admission (paged adapter, under the cond lock):
+        take references on the longest cached prefix, evict refcount-0
+        LRU blocks if the fresh remainder needs room, allocate the rest.
+        ``r.matched_tokens == len(prompt)`` flags the block-aligned full
+        match whose COW copy the caller performs after the lock."""
+        cfg = self.config
+        total = cfg.blocks_needed(len(r.prompt), r.max_new)
+        plen = len(r.prompt)
+        if coh.prefix is None or not self._worth_replaying(
+                coh.prefix.probe(r.prompt), plen):
+            # miss (or a match too short to beat prefill): plain path —
+            # still evict refcount-0 LRU blocks under pool pressure;
+            # registration after prefill extends the cached chain
+            if coh.prefix is not None:
+                evicted = coh.prefix.ensure_free(total)
+                if evicted:
+                    self.metrics.record_prefix_evictions(evicted)
+            r.blocks = coh.allocator.alloc(total) if total else []
+            return
+        shared, matched = coh.prefix.match(r.prompt)
+        # the final prompt token must still be FED through decode for its
+        # next-token logits; when the match covers the whole prompt that
+        # feed writes inside the last shared block -> COW copy needed
+        fresh = total - len(shared) + (1 if matched == plen and shared
+                                       else 0)
+        evicted = coh.prefix.ensure_free(fresh)
+        if evicted:
+            self.metrics.record_prefix_evictions(evicted)
+        r.blocks = coh.allocator.alloc(fresh) if fresh else []
+        r.shared_blocks = shared
+        r.matched_tokens = matched
+
     def _admit(self):
         cfg = self.config
         cands: List[_GenRequest] = []
@@ -306,20 +372,61 @@ class ModelRuntime:
                 return
             coh = self._cohort_for_admission()
             max_p = cfg.prefill_batches[-1]
+            blk = cfg.block_len
             while self._queue and self._slots_free and len(cands) < max_p:
                 r = self._queue[0]
-                need = 0 if coh.ps.adapter == "state" else \
-                    cfg.blocks_needed(len(r.prompt), r.max_new)
-                if need > coh.allocator.free_blocks:
-                    break            # head-of-line: wait for blocks to free
+                if coh.ps.adapter != "state":
+                    total = cfg.blocks_needed(len(r.prompt), r.max_new)
+                    budget = coh.allocator.free_blocks
+                    fresh = total
+                    if coh.prefix is not None:
+                        m = coh.prefix.probe(r.prompt)
+                        if not self._worth_replaying(m, len(r.prompt)):
+                            m = 0                # short match -> plain miss
+                        fresh = total - m + \
+                            (1 if m and m * blk == len(r.prompt) else 0)
+                        budget += coh.prefix.evictable_for(r.prompt)
+                    if fresh > budget:
+                        break        # head-of-line: wait for blocks to free
                 self._queue.popleft()
-                r.blocks = coh.allocator.alloc(need) if need else []
+                # register the request for failure delivery BEFORE block
+                # setup: if _setup_blocks raises (an accounting bug —
+                # the head-of-line budget above should prevent it), the
+                # loop's _fail_all resolves this caller instead of
+                # leaving a popped-but-unregistered stream hanging
                 r.slot = self._slots_free.pop()
                 r.cohort = coh
                 self._slot_req[r.slot] = r
+                if coh.ps.adapter != "state":
+                    self._setup_blocks(coh, r)
                 cands.append(r)
         if not cands:
             return
+        for r in cands:
+            if r.trace_id is not None:
+                # admission: queue -> slot handoff, stamped per request
+                # (the loop thread has no context of its own)
+                event("generation.admit", trace_id=r.trace_id,
+                      model=self.name, slot=r.slot,
+                      queue_ms=round((time.monotonic() - r.enqueue_t) * 1e3,
+                                     3))
+        hits = [r for r in cands if r.matched_tokens]
+        misses = [r for r in cands if not r.matched_tokens]
+        if misses:
+            self._prefill_misses(coh, misses)
+        if hits:
+            self._admit_hits(coh, hits)
+        if coh.ps.spec_k:
+            # speculating requests only: sampling/opted-out rows would
+            # waste draft compute and could force a larger (P, L) rung
+            spec_cands = [r for r in cands if r.spec]
+            if spec_cands:
+                self._draft_prefill(coh, spec_cands)
+        if coh.prefix is not None:
+            self.metrics.set_prefix_gauges(coh.prefix.stats())
+
+    def _prefill_misses(self, coh: _Cohort, cands: List["_GenRequest"]):
+        cfg = self.config
         S, mb = cfg.decode_slots, cfg.blocks_per_seq
         P = cfg.prefill_rung(len(cands))
         L = cfg.prompt_rung(max(len(r.prompt) for r in cands))
@@ -337,14 +444,6 @@ class ModelRuntime:
             slots[i] = r.slot
             temp[i] = r.temperature
             topk[i] = r.top_k
-        for r in cands:
-            if r.trace_id is not None:
-                # admission: queue -> slot handoff, stamped per request
-                # (the loop thread has no context of its own)
-                event("generation.admit", trace_id=r.trace_id,
-                      model=self.name, slot=r.slot,
-                      queue_ms=round((time.monotonic() - r.enqueue_t) * 1e3,
-                                     3))
         with span("generation.prefill", model=self.name, batch=len(cands),
                   rung=L):
             first, coh.cache, self._key = coh.ps.run_prefill(
@@ -359,6 +458,18 @@ class ModelRuntime:
             self._pos[s] = len(r.prompt)
             self._temp[s] = r.temperature
             self._topk[s] = r.top_k
+            if coh.prefix is not None:
+                self.metrics.record_prefix_miss()
+                # the prompt's full blocks are immutable from here on:
+                # index them so the next identical prefix skips this
+                # prefill; custody of the registered blocks moves to the
+                # cache (released at finish, not freed)
+                managed = coh.prefix.register(r.prompt, tables_p[i],
+                                              r.blocks)
+                if managed:
+                    drop = set(managed)
+                    r.blocks = [b for b in r.blocks if b not in drop]
+                    r.shared_blocks.extend(managed)
             if r.trace_id is not None:
                 event("generation.prefill", trace_id=r.trace_id,
                       model=self.name, slot=s, rung=int(L),
@@ -370,6 +481,72 @@ class ModelRuntime:
             len(cands), [(now - r.enqueue_t) * 1e3 for r in cands],
             emitted)
 
+    def _admit_hits(self, coh: _Cohort, hits: List["_GenRequest"]):
+        """Cache-hit admission: NO target prefill. The sequence's table
+        points at the shared read-only blocks; the unmatched prompt suffix
+        replays through the warmed decode program (one token per step,
+        teacher-forced), and the first emitted token falls out of the step
+        that feeds the final prompt token. Block-aligned full matches COW
+        the last shared block first — its final position gets rewritten by
+        that feed, and shared blocks are never written."""
+        blk = self.config.block_len
+        for r in hits:
+            s = r.slot
+            plen = len(r.prompt)
+            cow = 0
+            if r.matched_tokens == plen:
+                # copy-on-write: table entry m-1 becomes a private copy
+                src = r.shared_blocks[-1]
+                dst = r.blocks[0]
+                coh.cache = coh.ps.run_cow(coh.cache, src, dst)
+                coh.prefix.release([src])
+                r.shared_blocks = r.shared_blocks[:-1]
+                coh.prefix.cow_copies += 1
+                self.metrics.record_cow()
+                table = r.shared_blocks + [dst] + r.blocks[1:]
+                start = plen - 1
+                cow = 1
+            else:
+                table = r.shared_blocks + r.blocks
+                start = r.matched_tokens
+            row = np.zeros(self.config.blocks_per_seq, np.int32)
+            row[:len(table)] = table
+            coh.slots.add(s)
+            coh.tables[s] = row
+            self._pos[s] = start
+            self._temp[s] = r.temperature
+            self._topk[s] = r.top_k
+            self._tokens[s] = int(r.prompt[start])
+            self._active[s] = True
+            r.replay = deque(int(t) for t in r.prompt[start + 1:])
+            r.replaying = True
+            self.metrics.record_prefix_hit(start)
+            if r.trace_id is not None:
+                event("generation.prefix_hit", trace_id=r.trace_id,
+                      model=self.name, slot=s,
+                      matched_tokens=int(r.matched_tokens),
+                      shared_blocks=len(r.shared_blocks) + cow,
+                      cow=cow, replay_tokens=plen - start)
+
+    def _draft_prefill(self, coh: _Cohort, cands: List["_GenRequest"]):
+        """The draft consumes every admitted FULL prompt (hits included —
+        the target skipped its matched span, the draft is cheap and has no
+        paged cache to share)."""
+        cfg = self.config
+        S = cfg.decode_slots
+        P = cfg.prefill_rung(len(cands))
+        L = cfg.prompt_rung(max(len(r.prompt) for r in cands))
+        tokens = np.zeros((P, L), np.int32)
+        lengths = np.ones(P, np.int32)
+        slots = np.full(P, S, np.int32)
+        for i, r in enumerate(cands):
+            plen = len(r.prompt)
+            tokens[i, :plen] = r.prompt
+            lengths[i] = plen
+            slots[i] = r.slot
+        coh.draft_cache = coh.ps.run_draft_prefill(coh.draft_cache, tokens,
+                                                   lengths, slots)
+
     def _step(self):
         cfg = self.config
         S = cfg.decode_slots
@@ -377,39 +554,178 @@ class ModelRuntime:
             live = [s for s in sorted(coh.slots) if self._active[s]]
             if not live:
                 continue
-            mask = np.zeros(S, np.bool_)
-            mask[live] = True
-            t0 = time.perf_counter()
-            with span("generation.decode_step", model=self.name,
-                      slots=len(live)):
-                nxt, coh.cache, self._key = coh.ps.run_decode(
-                    coh.cache, self._tokens, self._pos, coh.tables, mask,
-                    self._key, self._temp, self._topk)
-            dt_ms = (time.perf_counter() - t0) * 1e3
-            now = time.monotonic()
-            emitted = 0
-            for s in live:
-                r = self._slot_req[s]
-                if r.trace_id is not None:
-                    # one event per decode step the request participated
-                    # in — the per-request timeline's heartbeat
-                    event("generation.decode_step", trace_id=r.trace_id,
-                          model=self.name, slot=s, token_index=r.emitted,
-                          step_ms=round(dt_ms, 3))
-                did_emit, cont = self._slot_emit(coh, r, int(nxt[s]), now)
-                emitted += did_emit
-                if cont:
-                    self._pos[s] += 1
-            self.metrics.record_decode_step(
-                dt_ms, len(live), emitted, slots=S,
-                blocks_used=coh.allocator.used_blocks,
-                blocks_total=coh.allocator.total_usable,
-                queue_depth=len(self._queue))
+            # speculative slots (greedy, past replay) advance through
+            # draft-propose + one batched verify; everything else —
+            # spec disabled, sampling requests, prompt-suffix replay —
+            # rides the plain one-token decode program
+            spec_on = coh.ps.spec_k > 0
+            plain = [s for s in live
+                     if not spec_on or not self._slot_req[s].spec
+                     or self._slot_req[s].replaying]
+            specs = [s for s in live if s not in set(plain)]
+            if plain:
+                self._plain_step(coh, plain)
+            if specs:
+                self._spec_step(coh, specs)
         if self._det is not None:
             self.metrics.record_recompile(self._det.count)
         # drop drained cohorts (old params/pools released)
         self._cohorts = [c for c in self._cohorts
                          if c.slots or c.ps is self.active_ps]
+        if not self._slot_req:
+            self._check_quiesce()
+
+    def _plain_step(self, coh: _Cohort, live: List[int]):
+        cfg = self.config
+        S = cfg.decode_slots
+        mask = np.zeros(S, np.bool_)
+        mask[live] = True
+        t0 = time.perf_counter()
+        with span("generation.decode_step", model=self.name,
+                  slots=len(live)):
+            nxt, coh.cache, self._key = coh.ps.run_decode(
+                coh.cache, self._tokens, self._pos, coh.tables, mask,
+                self._key, self._temp, self._topk)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        now = time.monotonic()
+        emitted = 0
+        for s in live:
+            r = self._slot_req[s]
+            if r.trace_id is not None:
+                # one event per decode step the request participated
+                # in — the per-request timeline's heartbeat
+                event("generation.decode_step", trace_id=r.trace_id,
+                      model=self.name, slot=s, token_index=r.emitted,
+                      step_ms=round(dt_ms, 3))
+            if r.replaying:
+                emitted += self._replay_advance(coh, r, int(nxt[s]), now)
+                continue
+            did_emit, cont = self._slot_emit(coh, r, int(nxt[s]), now)
+            emitted += did_emit
+            if cont:
+                self._pos[s] += 1
+        self.metrics.record_decode_step(
+            dt_ms, len(live), emitted, slots=S,
+            blocks_used=coh.allocator.used_blocks,
+            blocks_total=coh.allocator.total_usable,
+            queue_depth=len(self._queue))
+
+    def _replay_advance(self, coh: _Cohort, r: "_GenRequest", sampled: int,
+                        now: float) -> int:
+        """One replay step for a cache-hit admission: the decode program
+        just fed prompt[pos]. While suffix tokens remain the sample is a
+        mid-prompt prediction — discarded, teacher-force the next prompt
+        token. The step that fed the FINAL prompt token produced the first
+        generated token: record the cached TTFT and emit. Returns tokens
+        emitted (0 or 1)."""
+        s = r.slot
+        if r.cancelled or now > r.deadline:
+            if r.cancelled:
+                err = GenerationClosedError("engine stopped mid-generation") \
+                    if r.cancel_reason == "shutdown" else None
+                self._finish_slot(coh, r, r.cancel_reason, err)
+            else:
+                self._finish_slot(coh, r, "deadline", DeadlineExceededError(
+                    "deadline expired while replaying the prompt suffix"))
+            return 0
+        if r.replay:
+            self._tokens[s] = r.replay.popleft()
+            self._pos[s] += 1
+            return 0
+        r.replaying = False
+        self.metrics.record_cached_first_token(
+            (now - r.enqueue_t) * 1e3)
+        if coh.prefix is not None:
+            # full prompt blocks beyond the matched span are now valid:
+            # index them so the NEXT request extends the cached chain
+            managed = coh.prefix.register(r.prompt, coh.tables[s], r.blocks)
+            if managed:
+                drop = set(managed)
+                r.blocks = [b for b in r.blocks if b not in drop]
+                r.shared_blocks.extend(managed)
+            self.metrics.set_prefix_gauges(coh.prefix.stats())
+        did_emit, cont = self._slot_emit(coh, r, sampled, now)
+        if cont:
+            self._pos[s] += 1
+        return did_emit
+
+    def _spec_step(self, coh: _Cohort, specs: List[int]):
+        """Draft proposes k tokens per slot; ONE batched target pass
+        verifies; the longest agreeing prefix + the target's correction
+        token are emitted — plain-greedy-identical output, up to k+1
+        tokens per target dispatch."""
+        from .speculative import accept_greedy
+        cfg = self.config
+        S, k = cfg.decode_slots, coh.ps.spec_k
+        mask = np.zeros(S, np.bool_)
+        mask[specs] = True
+        t0 = time.perf_counter()
+        with span("generation.verify", model=self.name, slots=len(specs),
+                  k=k):
+            props, aux = coh.ps.run_propose(
+                coh.draft_cache, self._tokens, self._pos, mask)
+            if coh.ps.draft_adapter == "dense":
+                coh.draft_cache = aux
+            feeds = np.concatenate(
+                [self._tokens[:, None], props], axis=1).astype(np.int32)
+            targets, coh.cache = coh.ps.run_verify(
+                coh.cache, feeds, self._pos, coh.tables, mask)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        counts, emitted_toks = accept_greedy(props, targets)
+        now = time.monotonic()
+        emitted = 0
+        accepted = 0
+        cont_mask = np.zeros(S, np.bool_)
+        rewind_idx = np.ones(S, np.int32)
+        for s in specs:
+            r = self._slot_req[s]
+            if r.trace_id is not None:
+                event("generation.verify", trace_id=r.trace_id,
+                      model=self.name, slot=s, token_index=r.emitted,
+                      proposed=k, accepted=int(counts[s]),
+                      step_ms=round(dt_ms, 3))
+            accepted += int(counts[s])
+            n_emit, cont = 0, False
+            for tok in emitted_toks[s]:
+                did, cont = self._slot_emit(coh, r, int(tok), now)
+                n_emit += did
+                if not cont:
+                    break
+            emitted += n_emit
+            if cont:
+                self._pos[s] += n_emit
+                cont_mask[s] = True
+                rewind_idx[s] = n_emit
+        if coh.ps.draft_adapter == "state":
+            # commit, per continuing slot, the draft state matching what
+            # verify accepted (s_{j+1} = after the j-th accepted proposal)
+            coh.draft_cache = coh.ps.run_rewind(
+                coh.draft_cache, aux, rewind_idx, cont_mask)
+        self.metrics.record_verify(
+            dt_ms, len(specs), proposed=k * len(specs), accepted=accepted,
+            emitted=emitted, slots=S,
+            blocks_used=coh.allocator.used_blocks,
+            blocks_total=coh.allocator.total_usable,
+            queue_depth=len(self._queue))
+
+    def _check_quiesce(self):
+        """Block-accounting invariant at quiesce (no in-flight requests):
+        every allocated block is exactly a cached block (refcounted owner
+        refs are gone, so cached == prefix index incl. its LRU). A
+        violation is a leak or a double-custody bug — fail loudly (the
+        loop's defensive except turns this into _fail_all + a flight
+        dump) rather than serving corrupt shared state."""
+        for coh in self._cohorts:
+            if coh.ps.adapter != "paged" or coh.slots:
+                continue
+            alloc = set(coh.allocator.allocated)
+            cached = (coh.prefix.cached_block_ids()
+                      if coh.prefix is not None else set())
+            if alloc != cached:
+                raise RuntimeError(
+                    f"block accounting violated at quiesce for model "
+                    f"'{self.name}': leaked={sorted(alloc - cached)} "
+                    f"phantom={sorted(cached - alloc)}")
 
     def _slot_emit(self, coh: _Cohort, r: _GenRequest, tok: int,
                    now: float):
@@ -450,6 +766,14 @@ class ModelRuntime:
         if r.blocks:
             coh.allocator.free(r.blocks)
             r.blocks = []
+        if r.shared_blocks:
+            # cache-custody blocks: drop this sequence's reference;
+            # refcount-0 blocks park in the LRU for the next identical
+            # prefix (eviction under pool pressure frees them)
+            coh.prefix.release(r.shared_blocks)
+            r.shared_blocks = []
+        if coh.prefix is not None:
+            self.metrics.set_prefix_gauges(coh.prefix.stats())
         coh.slots.discard(s)
         self._active[s] = False
         with self._cond:

@@ -8,7 +8,10 @@ to 400):
   POST /predict/<model>    same, routed to a named model
   POST /generate           {"prompt": [ids], "max_tokens"?, "temperature"?,
                             "top_k"?, "stop"?: [ids], "timeout_ms"?,
-                            "stream"?: bool (default true)}
+                            "stream"?: bool (default true),
+                            "speculative"?: bool (default true — opt a
+                            request out of draft-verify decode on a
+                            speculating model)}
                            stream=true -> chunked NDJSON: one
                            {"token": id} line per generated token, then a
                            {"done": true, "reason": ..., "tokens": n}
@@ -297,6 +300,7 @@ class ServingHTTPServer:
                     timeout = None if timeout is None \
                         else float(timeout) / 1e3
                     stream = bool(req.get("stream", True))
+                    speculative = bool(req.get("speculative", True))
                 except Exception as e:
                     write_json(self, 400, {"error": f"bad request: {e}"})
                     return
@@ -304,7 +308,8 @@ class ServingHTTPServer:
                     ts = generation.generate(
                         prompt, model=model, max_tokens=max_tokens,
                         temperature=temperature, top_k=top_k, stop=stop,
-                        timeout=timeout, stream=True)
+                        timeout=timeout, stream=True,
+                        speculative=speculative)
                 except Exception as e:
                     write_json(self, status_for(e), _error_body(e))
                     return

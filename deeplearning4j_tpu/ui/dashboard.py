@@ -123,6 +123,14 @@ def _render_telemetry_card(title: str) -> str:
             model = name[len("serving."):-len(".latency_ms")]
             headline.append((f"serving p99 [{model}] (ms)",
                              round(h["p99"], 3)))
+    # generation prefix-cache economics (ISSUE 14): the hit rate is the
+    # headline — it is the prefill work the pool sharing saved
+    for name, g in sorted(gauges.items()):
+        if name.startswith("generation.") and \
+                name.endswith(".prefix_hit_rate"):
+            model = name[len("generation."):-len(".prefix_hit_rate")]
+            headline.append((f"prefix-cache hit rate [{model}]",
+                             round(g["value"], 4)))
     rows = "".join(
         f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
         for k, v in headline)

@@ -1,0 +1,125 @@
+"""Generation at user scale: prefix-cache sharing + speculative decoding.
+
+Walks both ISSUE 14 engines end to end on CPU:
+  1. build a target LM + a TRUNCATED-transformer draft sharing its
+     weights, warm a GenerationEngine with both features on — prefill
+     rungs, decode step, COW copy, draft prefill/propose and the batched
+     verify window all AOT-compiled up front;
+  2. prefix-cache sharing: a long block-aligned "system prompt" pays
+     prefill ONCE — repeats match the rolling prefix hash, take refcounted
+     references on the shared read-only blocks, COW the final block, and
+     reach their first token in ~one decode step (watch the cached-vs-
+     uncached TTFT);
+  3. a divergent continuation after the shared prefix stays token-for-
+     token identical to its own cache-free greedy decode;
+  4. speculative decoding: the draft proposes k tokens, ONE batched
+     verify pass accepts the longest agreeing prefix + the target's
+     correction token — same tokens as plain greedy, fewer target
+     dispatches (accepted_tokens_per_verify is the per-dispatch yield);
+  5. both composed under concurrent clients with ZERO steady-state XLA
+     compiles, proven by the process-wide compile counter;
+  6. the /metrics block-pool economics: hit rate, shared blocks, COW
+     copies, cached-LRU size, evictions.
+
+Run: python examples/speculative_decode.py
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.models.decode import (TransformerDecodeSpec,
+                                              naive_generate,
+                                              truncated_draft)
+from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+from deeplearning4j_tpu.serving import GenerationEngine, xla_compile_count
+
+VOCAB = 101
+
+print("== 1. target + truncated draft, warm everything ==")
+net = transformer_lm(vocab_size=VOCAB, d_model=64, n_heads=2, n_blocks=2,
+                     max_length=128, seed=7, token_input=True).init()
+# the draft = the target's first block + shared embed/head: where the
+# second block's refinement is small, greedy agreement is high. A random
+# init has NO such structure, so (like a distilled draft would) scale the
+# second block's residual contribution down to put the toy model in the
+# trained-draft agreement regime:
+params = list(net.params)
+for i, name in enumerate(net.vertex_names):
+    if name == "b1_attn":
+        p = dict(params[i])
+        p["Wo"], p["b"] = p["Wo"] * 0.25, p["b"] * 0.25
+        params[i] = p
+    elif name == "b1_ff2":
+        params[i] = {k: v * 0.25 for k, v in params[i].items()}
+net.params = tuple(params)
+draft = truncated_draft(net, n_blocks=1)
+eng = GenerationEngine(net, model_name="lm", block_len=16, max_seq_len=128,
+                       decode_slots=4, prefill_batches=(1, 2),
+                       prompt_rungs=(128,), draft=draft, spec_k=4)
+print(f"model: {json.dumps(eng.models()['lm'], indent=2)}")
+
+print("\n== 2. prefix cache: pay prefill once for a shared system prompt ==")
+rng = np.random.default_rng(3)
+system = rng.integers(1, VOCAB, size=96).tolist()   # 6 full blocks, aligned
+
+def ttft(prompt):
+    """Client-side time to FIRST streamed token."""
+    t0 = time.perf_counter()
+    st = eng.generate(prompt, max_tokens=8, stream=True)
+    it = iter(st)
+    first = next(it)
+    dt = (time.perf_counter() - t0) * 1e3
+    return dt, [first] + list(it)
+
+uncached_ms, first_tokens = ttft(system)
+cached_ms, repeat_tokens = ttft(system)
+assert repeat_tokens == first_tokens
+print(f"TTFT uncached: {uncached_ms:.1f} ms -> cached repeat: "
+      f"{cached_ms:.1f} ms (prefill skipped: COW + one decode step)")
+
+print("\n== 3. divergent continuation stays bit-exact ==")
+question = system + rng.integers(1, VOCAB, size=9).tolist()
+spec = TransformerDecodeSpec(net)
+want = naive_generate(net, question, 12, pad_to=128, spec=spec)
+got, _ = eng.generate(question, max_tokens=12)
+assert got == want, "cached-prefix decode diverged from naive greedy!"
+print(f"shared 96-token prefix, private suffix -> {got[:6]}... == naive")
+
+print("\n== 4. speculative decoding: tokens per target dispatch ==")
+prompt = rng.integers(1, VOCAB, size=12).tolist()
+want = naive_generate(net, prompt, 24, pad_to=128, spec=spec)
+got, _ = eng.generate(prompt, max_tokens=24)
+assert got == want, "speculative greedy diverged from plain greedy!"
+sp = eng.metrics()["lm"]["speculative"]
+print(f"exact output, {sp['verify_steps']} verify windows, "
+      f"accepted_tokens_per_verify={sp['accepted_tokens_per_verify']} "
+      f"(plain decode = 1.0 by definition)")
+
+print("\n== 5. composed, concurrent, zero steady-state compiles ==")
+compiles0 = xla_compile_count()
+outs = {}
+
+def client(i):
+    p = system if i % 2 == 0 else prompt
+    outs[i] = eng.generate(p, max_tokens=12)[0]
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert len({tuple(outs[i]) for i in range(0, 8, 2)}) == 1
+assert xla_compile_count() == compiles0
+print(f"8 concurrent clients (hits + speculation interleaved), "
+      f"compiles: {xla_compile_count() - compiles0}")
+
+print("\n== 6. block-pool economics ==")
+print(json.dumps(eng.metrics()["lm"]["prefix"], indent=2))
+eng.stop()
+print("\ndone.")

@@ -208,8 +208,14 @@ def test_killworker_and_preemption_leave_black_boxes(recorder,
     # box holds what finished happening, which for a step loop is steps)
     spans_ = [e for e in kill["events"] if e.get("cat") == "span"]
     assert any(e["name"] == "step" for e in spans_)
-    # every one of them carries the elastic run's single trace id
-    ids = {e["args"].get("trace_id") for e in spans_}
+    # every STEP span carries the elastic run's single trace id. Only the
+    # step spans: the background checkpoint writer's checkpoint_write
+    # span has no request context (worker thread, no handoff) and races
+    # the step-5 dump — under a slow fit (cold compile, co-tenant load)
+    # it lands inside the ring window, under a fast one it closes after;
+    # asserting over ALL spans made the pin depend on that timing
+    ids = {e["args"].get("trace_id") for e in spans_
+           if e["name"] == "step"}
     assert len(ids) == 1 and None not in ids
 
 

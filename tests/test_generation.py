@@ -513,8 +513,11 @@ def test_generate_bench_smoke():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
+    # prefix=False: the prefix sub-rows have their OWN tier-1 guard
+    # (tests/test_prefix_cache.py::test_prefix_cache_bench_smoke) — no
+    # need to warm the d=128 prefix-phase engine twice per tier-1 run
     row = bench.bench_generate(duration=0.8, clients=3, decode_slots=4,
-                               max_new=8, prompt_len=4)
+                               max_new=8, prompt_len=4, prefix=False)
     assert row["continuous_tokens_per_sec"] > 0
     assert row["sequential_tokens_per_sec"] > 0
     assert row["continuous_steady_state_compiles"] == 0

@@ -131,8 +131,15 @@ def test_pipeline_stack_gradients():
     assert _central_diff_check(loss, flat0, subset=60)
 
 
+@pytest.mark.slow
 def test_bidirectional_lstm_masked_gradients():
-    """GravesBidirectionalLSTM with variable-length masks, f64: the scan
+    """Slow lane (ISSUE 14 tier-1 budget reclaim): ~10s combination
+    variant — bidirectional-LSTM gradients stay tier-1
+    (test_recurrent.test_lstm_gradient_checks[GravesBidirectionalLSTM])
+    and masked recurrent gradients stay tier-1 (the seq2seq
+    masked-gradient check in test_graph_recurrent).
+
+    GravesBidirectionalLSTM with variable-length masks, f64: the scan
     twin of the fused kernel, numerically verified end-to-end through the
     MLN loss (masked loss + masked eval; reference
     GradientCheckTestsMasking)."""

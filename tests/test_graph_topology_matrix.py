@@ -28,8 +28,14 @@ def _builder():
                                    dtype="float64").graph_builder())
 
 
+@pytest.mark.slow
 def test_video_pipeline_rnn_cnn_rnn_chain():
-    """The time-distributed video pipeline (reference CnnToRnnPreProcessor /
+    """Slow lane (ISSUE 14 tier-1 budget reclaim): ~7s, the deepest chain
+    in the topology matrix; both preprocessor seams it composes stay
+    tier-1-covered (test_rnn_to_cnn_style_pool_then_dense and the
+    elementwise-add-over-parallel-rnn-branches chain).
+
+    The time-distributed video pipeline (reference CnnToRnnPreProcessor /
     RnnToCnnPreProcessor seam): recurrent frames -> RnnToCnn (T folds into
     batch) -> conv per frame -> CnnToRnn (restore [B,T,F]) -> LSTM ->
     global pool -> out. Explicit preprocessors, full chain gradient-checked."""

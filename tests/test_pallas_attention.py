@@ -38,7 +38,12 @@ def test_applicability_probe():
     assert not fused_attention_applicable(B, H, T, D, jnp.float64)
 
 
-@pytest.mark.parametrize("d", [64, 96])
+@pytest.mark.parametrize("d", [
+    64,
+    # d=96 in the slow lane (ISSUE 14 tier-1 budget reclaim): ~5s second
+    # head-dim config; d=64 keeps the small-head-dim kernel path tier-1
+    pytest.param(96, marks=pytest.mark.slow),
+])
 def test_small_head_dim_parity(d):
     """D=64/96 (the common transformer head dims) engage the fused path
     and match the XLA reference, gradients included."""

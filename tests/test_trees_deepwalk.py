@@ -73,7 +73,11 @@ def test_quadtree_2d_only():
 
 
 # ----------------------------------------------------------- Barnes-Hut tSNE
+@pytest.mark.slow
 def test_barnes_hut_tsne_separates_clusters():
+    # slow lane (ISSUE 14 tier-1 budget reclaim): ~11s end-to-end quality
+    # soak; the BH force math itself stays tier-1-verified EXACTLY against
+    # the theta=0 per-point sum (test_sptree_mass_and_bh_forces_...)
     a = R.normal(size=(40, 10)) + 8.0
     b = R.normal(size=(40, 10)) - 8.0
     X = np.vstack([a, b])

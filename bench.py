@@ -186,25 +186,27 @@ class BenchImplausible(RuntimeError):
 
 
 def _cost_analysis(compiled) -> dict:
-    """Normalize compiled.cost_analysis() across backends (list-of-dict on
-    some, dict on others, occasionally neither) — the ONE place that knows
-    the quirk."""
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    return ca if hasattr(ca, "get") else {}
+    """Normalize compiled.cost_analysis() across backends — delegates to
+    telemetry/perf.py, the ONE shared implementation (bench rows and the
+    live perf gauges can never disagree on the normalization)."""
+    from deeplearning4j_tpu.telemetry.perf import cost_analysis_of
+    return cost_analysis_of(compiled)
 
 
 def _implied_mfu(flops_per_step, dt):
-    """MFU implied by a measured per-step time (None if flops unknown)."""
-    if not flops_per_step or not dt or dt <= 0:
-        return None
-    return flops_per_step / dt / 1e12 / PEAK_TFLOPS
+    """MFU implied by a measured per-step time (None if flops unknown).
+    Shared formula (telemetry/perf.py) against this module's peak — the
+    module constant keeps env/test overrides of PEAK_TFLOPS working."""
+    from deeplearning4j_tpu.telemetry.perf import implied_mfu
+    return implied_mfu(flops_per_step, dt, peak=PEAK_TFLOPS)
 
 
 def _roofline_dt(flops_per_step):
-    """Fastest physically plausible per-step time at the MFU ceiling."""
-    return flops_per_step / (PEAK_TFLOPS * 1e12 * MAX_PLAUSIBLE_MFU)
+    """Fastest physically plausible per-step time at the MFU ceiling
+    (shared roofline math, telemetry/perf.py)."""
+    from deeplearning4j_tpu.telemetry.perf import roofline_dt
+    return roofline_dt(flops_per_step, peak=PEAK_TFLOPS,
+                       mfu_ceiling=MAX_PLAUSIBLE_MFU)
 
 
 def _invalid_row(items_per_step, flops_per_step, reason):
@@ -716,7 +718,8 @@ def bench_dispatch_bound(steps=None, ks=(1, 8), repeats=None):
 
 
 def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
-                             variants=("base", "traced", "serving")):
+                             variants=("base", "traced", "serving",
+                                       "perf")):
     """telemetry_overhead_pct: the enabled-telemetry tax on the WORST-case
     loop for it — the dispatch-bound tiny-MLP fit (per-step fit/epoch/step/
     dispatch spans + registry counters dominate nothing but themselves
@@ -743,8 +746,19 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
         through the warmed InferenceEngine with a fresh TraceContext per
         request (per-request admit/batch trace events — the HTTP-path
         cost) vs the same load with telemetry disabled.
-    The <5% acceptance bound on all three is enforced by the tier-1
-    bench_smoke guards (tests/test_telemetry.py, tests/test_tracing.py)."""
+
+    ISSUE 15 addition, same paired-best-of discipline:
+      - perf_accounting_overhead_pct: the FULL performance-accounting
+        layer (telemetry/perf.py — one-time cost capture per program,
+        per-step time decomposition buffers, epoch-boundary fold into
+        perf.* MFU/roofline gauges, live-array memory gauges) riding a
+        K=8 fused fit with the registry enabled, vs the same loop with
+        telemetry off. K=8/batch 32 is the accounting's design point:
+        capture is once per program, decomposition appends are per
+        WINDOW, and the fold runs at epoch boundaries.
+    The <5% acceptance bound on all four is enforced by the tier-1
+    bench_smoke guards (tests/test_telemetry.py, tests/test_tracing.py,
+    tests/test_perf.py)."""
     from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
     from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
@@ -792,18 +806,25 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
     mode_spec = {True: (True, False, 1, batch),
                  False: (False, False, 1, batch),
                  "traced": (True, True, 8, traced_batch),
+                 "perf8": (True, False, 8, traced_batch),
                  "bare8": (False, False, 8, traced_batch)}
     # ``variants`` lets the tier-1 guards pay only for what they assert
     # (the base guard predates the traced/serving variants)
-    unknown = set(variants) - {"base", "traced", "serving"}
+    unknown = set(variants) - {"base", "traced", "serving", "perf"}
     if unknown or not variants:
         raise ValueError(f"unknown variants {sorted(unknown)} "
-                         f"(choose from base/traced/serving)")
+                         f"(choose from base/traced/serving/perf)")
     modes = ()
     if "base" in variants:
         modes += (True, False)
     if "traced" in variants:
         modes += ("traced", "bare8")
+    if "perf" in variants:
+        # perf accounting rides the enabled registry (no watch, no trace
+        # context) — paired against the same bare K=8 loop
+        modes += ("perf8",)
+        if "bare8" not in modes:
+            modes += ("bare8",)
     times = {m: [] for m in modes}
     # the watch (and its worker thread) exists only for the traced
     # variant, and is close()d on the way out
@@ -880,6 +901,13 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
             (float(np.min(ratios)) - 1.0) * 100.0, 2)
         out["traced_steps_per_sec"] = round(
             steps / float(np.min(times["traced"])), 1)
+    if "perf" in variants:
+        # same paired best-of discipline as the traced variant
+        ratios = [t / b for t, b in zip(times["perf8"], times["bare8"])]
+        out["perf_accounting_overhead_pct"] = round(
+            (float(np.min(ratios)) - 1.0) * 100.0, 2)
+        out["perf_steps_per_sec"] = round(
+            steps / float(np.min(times["perf8"])), 1)
     if "serving" in variants:
         out.update(_telemetry_serving_overhead(
             make_net(), serving_requests, max(3, repeats - 2)))

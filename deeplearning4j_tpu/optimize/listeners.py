@@ -160,8 +160,14 @@ class PerformanceListener(TrainingListener):
 
     Each report also lands in the shared telemetry registry
     (``telemetry.get_registry()``): ``train.samples_per_sec`` /
-    ``train.batches_per_sec`` / ``train.steps_per_dispatch`` gauges and
-    ``train.etl_wait_ms`` / ``train.device_ms`` histograms.
+    ``train.batches_per_sec`` / ``train.windowed_steps_per_sec`` /
+    ``train.steps_per_dispatch`` gauges and ``train.etl_wait_ms`` /
+    ``train.device_ms`` histograms.
+
+    When the cost index (telemetry/perf.py) has captured the train-step
+    program, each record additionally carries ``mfu`` and
+    ``achieved_tflops`` — the device-time-implied utilization for the
+    report interval (history keys only; the log format is unchanged).
     """
 
     def __init__(self, frequency: int = 10, report_samples: bool = True):
@@ -218,12 +224,27 @@ class PerformanceListener(TrainingListener):
                    "windowed_steps_per_sec": self._batches / dt,
                    "steps_per_dispatch": steps_per_dispatch,
                    "score": float(score)}
+            # cost-model keys (telemetry/perf.py): MFU/achieved-TFLOP/s
+            # implied by this report's per-step device time against the
+            # captured train-step program cost — host floats only, read
+            # at the same window-aligned report point as the other keys
+            # (absent until a cost capture has landed; log line unchanged)
+            from ..telemetry.perf import get_cost_index, implied_mfu
+            cost = get_cost_index().train_cost()
+            if cost is not None and cost.flops_per_step and \
+                    rec["device_ms_per_iteration"] > 0:
+                dt_step_s = rec["device_ms_per_iteration"] / 1e3
+                rec["mfu"] = implied_mfu(cost.flops_per_step, dt_step_s)
+                rec["achieved_tflops"] = \
+                    cost.flops_per_step / dt_step_s / 1e12
             self.history.append(rec)
             from ..telemetry import get_registry
             reg = get_registry()
             if reg.enabled:
                 reg.gauge("train.samples_per_sec").set(rec["samples_per_sec"])
                 reg.gauge("train.batches_per_sec").set(rec["batches_per_sec"])
+                reg.gauge("train.windowed_steps_per_sec").set(
+                    rec["windowed_steps_per_sec"])
                 reg.gauge("train.steps_per_dispatch").set(steps_per_dispatch)
                 reg.histogram("train.etl_wait_ms").observe(etl_per_it)
                 reg.histogram("train.device_ms").observe(

@@ -13,6 +13,7 @@ recompiles.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Optional
 
@@ -73,10 +74,30 @@ def train_step_math(net, params, state, opt_state, it, rng, x, y,
     return new_params, new_state, new_opt, loss
 
 
+def _feed_sig(*feeds) -> tuple:
+    """Cheap hashable shape/dtype signature of the per-batch feed arrays
+    (params/state shapes are fixed per net, so the feed alone keys a
+    distinct XLA program) — the dedupe key for one-time cost capture."""
+    sig = []
+    for t in feeds:
+        if t is None:
+            continue
+        for leaf in (t if isinstance(t, (list, tuple)) else (t,)):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+    return tuple(sig)
+
+
 class Solver:
     def __init__(self, net):
         self.net = net
         self._steps = {}
+        self._cost_counts = {}      # (path, feed-sig) -> steps dispatched
+        # one capture attempt per path per solver: after it, the
+        # per-iteration accounting cost drops to one attribute check
+        # (a second feed shape's program is deliberately not captured —
+        # the hot program is the one whose MFU matters)
+        self._win_cost_done = False
+        self._step_cost_done = False
 
     # -------------------------------------------------------------- step fns
     def _get_step(self, has_lmask: bool, has_fmask: bool,
@@ -315,6 +336,17 @@ class Solver:
         from ..telemetry.tracecontext import (current_trace_context,
                                               new_trace_context,
                                               use_trace_context)
+        if reg.enabled:
+            # memory-profiler owner hints (telemetry/memprof.py): label
+            # the param tree once per fit so the live-array top-K table
+            # attributes these shapes — metadata only, no device reads
+            from ..telemetry import memprof
+            # opt_state first: SGD-style zero states share (shape, dtype)
+            # with their params — later hints win, params is the better
+            # label for the collision
+            if getattr(net, "opt_state", None) is not None:
+                memprof.tag(net.opt_state, "opt_state")
+            memprof.tag(net.params, "params")
         ctx = current_trace_context()
         with use_trace_context(ctx if ctx is not None
                                else new_trace_context()):
@@ -338,6 +370,34 @@ class Solver:
         for l in net.listeners:
             if isinstance(l, TrainingListener):
                 l.on_epoch_start(net)
+        # Performance accounting (telemetry/perf.py): one-time cost-model
+        # capture per distinct step program (an abstract lower() — no
+        # backend compile, no device read) + per-step time decomposition
+        # buffered on this thread and flushed/folded into perf.* gauges at
+        # window/epoch boundaries. SGD paths only — tbptt/second-order
+        # keep their own step structure (same scoping as TrainingWatch).
+        acct = cost_index = None
+        if reg.enabled and not tbptt and second_order is None:
+            from ..telemetry.perf import (StepAccounting,
+                                          accounting_enabled,
+                                          get_cost_index)
+            if accounting_enabled():
+                acct = StepAccounting(reg)
+                cost_index = get_cost_index()
+        # Solver-owned window-dispatch timing: the cost index pairs the
+        # captured window program with THIS histogram rather than the
+        # span.dispatch_ms one, which ParallelWrapper's dispatch spans
+        # also feed — a PW fit in the same process must not pollute the
+        # fit program's MFU denominator
+        _h_disp = (reg.histogram("perf.fit.dispatch_ms")
+                   if acct is not None else None)
+        # Capture only once a program has dispatched this many STEPS: the
+        # capturing lower() is a full (abstract) retrace — ~0.1s for a
+        # tiny net, seconds for a big one — so a short exploratory fit
+        # never pays it, while any run long enough for its MFU to matter
+        # amortizes it to noise. Lower it (e.g. 1) to capture immediately.
+        capture_after = max(1, int(os.environ.get(
+            "DL4J_TPU_PERF_CAPTURE_AFTER", "256")))
         # ETL timing (reference lastEtlTime, set in the fit loop
         # MultiLayerNetwork.java:1130 and reported by
         # PerformanceListener.java:111,178): with device prefetch the
@@ -390,10 +450,30 @@ class Solver:
                     if fms is not None:
                         kwargs["fmasks"] = fms
                     it0 = net.iteration_count
+                    if cost_index is not None and not self._win_cost_done:
+                        sig = ("fit-window", id(self), k,
+                               _feed_sig(xs, ys, lms, fms))
+                        c = self._cost_counts.get(sig, 0) + k
+                        self._cost_counts[sig] = c
+                        if c - k < capture_after <= c:
+                            self._win_cost_done = True
+                            # crossed the warm-up threshold: capture now,
+                            # BEFORE the dispatch (donation invalidates
+                            # params/opt_state buffers after the call)
+                            cost_index.maybe_capture(
+                                "fit/epoch/window", sig, step_fn,
+                                (net.params, net.state, net.opt_state,
+                                 jnp.asarray(it0, jnp.int32), base_rng,
+                                 xs, ys), kwargs, steps_per_call=k,
+                                timing_metric="perf.fit.dispatch_ms")
+                    t_d0 = time.perf_counter()
                     with span("dispatch", k=k):
                         out = step_fn(net.params, net.state, net.opt_state,
                                       jnp.asarray(it0, jnp.int32),
                                       base_rng, xs, ys, **kwargs)
+                    dispatch_ms = (time.perf_counter() - t_d0) * 1e3
+                    if _h_disp is not None:
+                        _h_disp.observe(dispatch_ms)
                     net.params, net.state, net.opt_state, losses = out[:4]
                     if watch is not None:
                         # [K, 3] device stack: appended, never read here
@@ -417,9 +497,16 @@ class Solver:
                             l.iteration_done(net, net.iteration_count,
                                              losses[i])
                         net.iteration_count += 1
+                if acct is not None:
+                    wall_ms = (time.perf_counter() - _etl_t0) * 1e3
+                    acct.on_step(input_wait_ms=etl_ms,
+                                 compute_ms=dispatch_ms,
+                                 host_ms=wall_ms - etl_ms - dispatch_ms,
+                                 steps=k)
                 _etl_t0 = time.perf_counter()
                 continue
             ds = item
+            dispatch_ms = None
             # ONE span per single-step iteration (the step IS the dispatch
             # here; a nested dispatch span would double the per-iteration
             # telemetry cost on the dispatch-bound path for no extra
@@ -447,10 +534,27 @@ class Solver:
                         kwargs["lmask"] = lmask
                     if fmask is not None:
                         kwargs["fmask"] = fmask
+                    if cost_index is not None and \
+                            not self._step_cost_done:
+                        sig = ("fit-step", id(self),
+                               _feed_sig(x, y, lmask, fmask))
+                        c = self._cost_counts.get(sig, 0) + 1
+                        self._cost_counts[sig] = c
+                        if c == capture_after:
+                            self._step_cost_done = True
+                            cost_index.maybe_capture(
+                                "fit/epoch/step", sig, step_fn,
+                                (net.params, net.state, net.opt_state,
+                                 jnp.asarray(net.iteration_count,
+                                             jnp.int32), rng, x, y),
+                                kwargs, steps_per_call=1,
+                                timing_metric="perf.step.compute_ms")
+                    t_d0 = time.perf_counter()
                     out = step_fn(
                         net.params, net.state, net.opt_state,
                         jnp.asarray(net.iteration_count, jnp.int32),
                         rng, x, y, **kwargs)
+                    dispatch_ms = (time.perf_counter() - t_d0) * 1e3
                     net.params, net.state, net.opt_state, loss = out[:4]
                     if watch is not None:
                         watch.on_health(net.iteration_count, out[4], 1)
@@ -470,14 +574,25 @@ class Solver:
                     l.iteration_done(net, it_idx, loss)
                 if not tbptt:
                     net.iteration_count += 1
+            if acct is not None and dispatch_ms is not None:
+                wall_ms = (time.perf_counter() - _etl_t0) * 1e3
+                acct.on_step(input_wait_ms=etl_ms, compute_ms=dispatch_ms,
+                             host_ms=wall_ms - etl_ms - dispatch_ms)
             _etl_t0 = time.perf_counter()
         for l in net.listeners:
             if isinstance(l, TrainingListener):
                 l.on_epoch_end(net)
         if reg.enabled:
             # device HBM watermark gauges, refreshed once per epoch (host
-            # API read; backends without memory_stats contribute nothing)
+            # API read; CPU backends fall back to live-array accounting)
             device_memory_gauges(reg)
+        if acct is not None:
+            # epoch boundary: flush the decomposition buffers, resolve
+            # every captured program against its timing histogram and
+            # publish the perf.<path>.mfu/.achieved_tflops/... gauges —
+            # pure host arithmetic, off the dispatch loop
+            acct.flush()
+            cost_index.fold(reg)
         if hasattr(iterator, "reset"):
             iterator.reset()
 

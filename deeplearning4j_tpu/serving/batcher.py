@@ -19,6 +19,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from ..telemetry.flightrec import get_flight_recorder
+from ..telemetry.registry import get_registry
 from ..telemetry.tracecontext import current_trace_id, event
 from .buckets import BucketLadder
 from .errors import (DeadlineExceededError, DrainingError, QueueFullError,
@@ -235,7 +236,16 @@ class ShapeBucketedBatcher:
             padded[off:off + r.n] = r.x
             off += r.n
         try:
+            t_run = time.perf_counter()
             out = self._runner(padded)
+            # per-bucket dispatch wall (the runner blocks on np.asarray,
+            # so this IS device-complete time) — the timing half of the
+            # cost index's serving bucket entries (telemetry/perf.py)
+            reg = get_registry()
+            if reg.enabled:
+                reg.histogram(
+                    f"serving.{self.name}.bucket{bucket}.dispatch_ms"
+                ).observe((time.perf_counter() - t_run) * 1e3)
         except Exception as e:                 # model/device-side failure
             self.metrics.record_rejection("error")
             for r in batch:

@@ -63,7 +63,8 @@ class InferenceEngine:
         ps = ProgramSet(net, feature_shape=feature_shape, ladder=ladder,
                         dtype=dtype or self.dtype, mesh=self.mesh,
                         data_axis=self.data_axis, forward_fn=forward_fn,
-                        trace_hook=self._on_trace)
+                        trace_hook=self._on_trace,
+                        cost_path=f"serving.{name}")
         if warm:
             ps.warm()
 
@@ -124,8 +125,8 @@ class InferenceEngine:
                 new_set = ProgramSet(
                     net, feature_shape=old.feature_shape, ladder=old.ladder,
                     dtype=old.dtype, mesh=old.mesh, data_axis=old.data_axis,
-                    forward_fn=old._custom_fwd,
-                    trace_hook=self._on_trace).warm()     # warm BEFORE swap
+                    forward_fn=old._custom_fwd, trace_hook=self._on_trace,
+                    cost_path=old.cost_path).warm()       # warm BEFORE swap
             entry.active = new_set                        # atomic cutover
             entry.version += 1
             entry.metrics.record_swap()

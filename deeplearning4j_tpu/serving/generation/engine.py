@@ -62,7 +62,8 @@ class GenerationEngine:
         try:
             ps = GenerationProgramSet(net, config=cfg, adapter=adapter,
                                       draft_net=draft,
-                                      trace_hook=self._on_trace)
+                                      trace_hook=self._on_trace,
+                                      cost_path=f"generation.{name}")
             if warm:
                 ps.warm()
         finally:
@@ -155,7 +156,8 @@ class GenerationEngine:
                     new_ps = GenerationProgramSet(
                         net, config=old.config, adapter="auto",
                         draft_net=draft or old.draft_net,
-                        trace_hook=self._on_trace).warm()
+                        trace_hook=self._on_trace,
+                        cost_path=old.cost_path).warm()
                 finally:
                     self._resume_detectors()
             rt.active_ps = new_ps         # atomic: next admission cohort

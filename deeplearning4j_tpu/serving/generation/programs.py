@@ -130,10 +130,13 @@ class GenerationProgramSet:
 
     def __init__(self, net, *, config: GenerationConfig,
                  adapter: str = "auto", draft_net=None,
-                 trace_hook: Optional[Callable[[], None]] = None):
+                 trace_hook: Optional[Callable[[], None]] = None,
+                 cost_path: Optional[str] = None):
         self.net = net
         self.config = config
         self._trace_hook = trace_hook
+        self.cost_path = cost_path    # e.g. "generation.<model>": enables
+        # cost-index registration of the warmed executables (perf.py)
         self.adapter = self._resolve_adapter(net, adapter)
         self.spec = (TransformerDecodeSpec(net) if self.adapter == "paged"
                      else LSTMDecodeSpec(net))
@@ -204,10 +207,18 @@ class GenerationProgramSet:
         the prefill-padding trash slot) for the state adapter."""
         c = self.config
         if self.adapter == "paged":
-            return make_pools(self.spec.n_blocks, c.num_blocks, c.block_len,
-                              self.spec.n_heads, self.spec.head_dim,
-                              self.dtype)
-        return jax.tree.map(jnp.zeros_like, self._init_states)
+            cache = make_pools(self.spec.n_blocks, c.num_blocks,
+                               c.block_len, self.spec.n_heads,
+                               self.spec.head_dim, self.dtype)
+        else:
+            cache = jax.tree.map(jnp.zeros_like, self._init_states)
+        try:     # memprof owner hint: the block pool dominates live HBM
+            from ...telemetry import memprof
+            memprof.tag(cache, (self.cost_path or "generation")
+                        + ".kvcache")
+        except Exception:       # pragma: no cover - defensive
+            pass
+        return cache
 
     def fresh_key(self):
         return jax.random.PRNGKey(self.config.seed)
@@ -386,7 +397,44 @@ class GenerationProgramSet:
             cache = self.run_cow(cache, 0, 0)
         if self.spec_k:
             cache = self._touch_spec(cache)
+        self._register_costs()
         return self
+
+    def _register_costs(self) -> None:
+        """Cost-model accounting (telemetry/perf.py): register every
+        warmed executable's cost analysis keyed by program. The decode
+        step and verify window pair with the per-step latency histograms
+        the scheduler already observes (``decode_step_ms`` /
+        ``verify_step_ms``), so the perf fold yields live MFU/roofline
+        gauges for the decode loop; prefill rungs register cost-only
+        (roofline classification without a paired timing stream). Never
+        raises into warm-up."""
+        if self.cost_path is None:
+            return
+        try:
+            from ...telemetry import get_registry
+            from ...telemetry.perf import (accounting_enabled,
+                                           get_cost_index)
+            if not (accounting_enabled() and get_registry().enabled):
+                return
+            idx = get_cost_index()
+            base = self.cost_path
+            idx.register(f"{base}.decode_step",
+                         program=self._compiled[("decode",)],
+                         items_per_step=float(self.config.decode_slots),
+                         timing_metric=f"{base}.decode_step_ms")
+            if ("verify",) in self._compiled:
+                idx.register(f"{base}.verify",
+                             program=self._compiled[("verify",)],
+                             items_per_step=float(self.config.decode_slots),
+                             timing_metric=f"{base}.verify_step_ms")
+            for key, compiled in self._compiled.items():
+                if key[0] == "prefill":
+                    _, P, L = key
+                    idx.register(f"{base}.prefill.b{P}xp{L}",
+                                 program=compiled, items_per_step=float(P))
+        except Exception:       # pragma: no cover - defensive
+            pass
 
     def _warm_spec(self, cache_spec, i32):
         """Compile the draft + verify executables (speculative decoding).
@@ -561,7 +609,8 @@ class GenerationProgramSet:
         new = GenerationProgramSet(net, config=self.config,
                                    adapter=self.adapter,
                                    draft_net=draft_net or self.draft_net,
-                                   trace_hook=self._trace_hook)
+                                   trace_hook=self._trace_hook,
+                                   cost_path=self.cost_path)
         if new.signature != self.signature:
             raise ValueError("parameter/architecture changed; full warm-up "
                              "required")

@@ -26,6 +26,10 @@ to 400):
   POST /reload             {"model": name, "path": zip-or-checkpoint-dir}
                            -> zero-downtime hot-swap (forward-serving OR
                            generation model), returns new version
+  POST /debug/flightrec    explicit flight-recorder dump (black box)
+  POST /debug/memprof      live memory profile: top-K live-array groups
+                           by (shape, dtype, owner) + per-device totals
+                           ({"top_k": n} optional body)
 
 Status mapping: malformed payload -> 400, unknown model -> 404, queue full
 OR KV block-pool exhaustion -> 429 (the latter with a retry_after_ms hint),
@@ -45,6 +49,7 @@ import numpy as np
 
 from ..telemetry import get_registry
 from ..telemetry.flightrec import get_flight_recorder
+from ..telemetry.perf import perf_snapshot
 from ..telemetry.slo import get_slo_watchdog
 from ..telemetry.tracecontext import (event, new_trace_context,
                                       use_trace_context)
@@ -180,11 +185,25 @@ class ServingHTTPServer:
                         # with the counters, not with a stale snapshot
                         body = dict(body)
                         body["slo"] = wd.check()
+                    # performance observability (telemetry/perf.py):
+                    # per-program MFU/roofline table + step decomposition
+                    # + memory profile, folded fresh per scrape (host
+                    # arithmetic over already-recorded metrics)
+                    if get_registry().enabled:
+                        body = dict(body)
+                        body["perf"] = perf_snapshot()
                     write_json(self, 200, body)
                 elif self.path == "/metrics/prometheus":
                     wd = get_slo_watchdog()
                     if wd is not None:
                         wd.check()        # refresh slo.* gauges pre-dump
+                    if get_registry().enabled:
+                        # refresh perf.* gauges too: a deployment scraped
+                        # only through this route would otherwise never
+                        # fold the cost index (and a ThroughputSLO on a
+                        # perf.*.mfu gauge would stay cold forever)
+                        from ..telemetry.perf import get_cost_index
+                        get_cost_index().fold(get_registry())
                     text = get_registry().to_prometheus_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -212,9 +231,29 @@ class ServingHTTPServer:
                     self._reload()
                 elif self.path == "/debug/flightrec":
                     self._flightrec()
+                elif self.path == "/debug/memprof":
+                    self._memprof()
                 else:
                     self._drain_body()
                     write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def _memprof(self):
+                """Live memory profile (telemetry/memprof.py): top-K
+                live-array groups by (shape, dtype, owner) + per-device
+                totals. Optional JSON body: {"top_k": n}."""
+                try:
+                    info = read_json(self)
+                    top_k = int(info.get("top_k", 10)) \
+                        if isinstance(info, dict) else 10
+                except Exception:
+                    top_k = 10
+                from ..telemetry import memprof
+                try:
+                    body = memprof.snapshot(top_k=max(1, min(top_k, 100)))
+                except Exception as e:     # pragma: no cover - defensive
+                    write_json(self, 500, {"error": str(e)})
+                    return
+                write_json(self, 200, body)
 
             def _flightrec(self):
                 """Explicit black-box dump: the operator's 'what has this

@@ -71,8 +71,11 @@ class ProgramSet:
                  ladder: BucketLadder, dtype="float32", mesh=None,
                  data_axis: str = "data",
                  forward_fn: Optional[Callable] = None,
-                 trace_hook: Optional[Callable[[], None]] = None):
+                 trace_hook: Optional[Callable[[], None]] = None,
+                 cost_path: Optional[str] = None):
         self.net = net
+        self.cost_path = cost_path      # e.g. "serving.<model>" — enables
+        # per-bucket cost-index registration at warm() (telemetry/perf.py)
         self.feature_shape = tuple(int(d) for d in feature_shape)
         self.ladder = ladder
         self.dtype = jnp.dtype(dtype)
@@ -124,11 +127,32 @@ class ProgramSet:
                 jitted = jax.jit(traced)
             self._compiled[b] = jitted.lower(
                 self.params, self.state, x_spec).compile()
+            self._register_cost(b)
             # touch the executable once so first real traffic doesn't pay
             # one-time dispatch setup either
             pad = np.zeros((b,) + self.feature_shape, self.dtype)
             np.asarray(self.run(pad))
         return self
+
+    def _register_cost(self, b: int) -> None:
+        """Cost-model accounting (telemetry/perf.py): register the AOT
+        executable's cost analysis keyed by bucket, paired with the
+        per-bucket dispatch-wall histogram the batcher observes — the
+        perf fold turns the two into live ``perf.serving...`` MFU/
+        roofline gauges. Never raises into warm-up."""
+        if self.cost_path is None:
+            return
+        try:
+            from ..telemetry import get_registry
+            from ..telemetry.perf import accounting_enabled, get_cost_index
+            if not (accounting_enabled() and get_registry().enabled):
+                return
+            get_cost_index().register(
+                f"{self.cost_path}.bucket{b}", program=self._compiled[b],
+                items_per_step=float(b),
+                timing_metric=f"{self.cost_path}.bucket{b}.dispatch_ms")
+        except Exception:       # pragma: no cover - defensive
+            pass
 
     @property
     def warmed(self) -> bool:
@@ -159,7 +183,8 @@ class ProgramSet:
                          ladder=self.ladder, dtype=self.dtype,
                          mesh=self.mesh, data_axis=self.data_axis,
                          forward_fn=self._custom_fwd,
-                         trace_hook=self._trace_hook)
+                         trace_hook=self._trace_hook,
+                         cost_path=self.cost_path)
         if new.signature != self.signature:
             raise ValueError("parameter/state shapes changed; full warm-up "
                              "required")

@@ -25,11 +25,15 @@ from .flightrec import (FlightRecorder, configure_flight_recorder,
 from .jaxsignals import (HostSyncDetector, HostSyncError, RecompileDetector,
                          device_memory_gauges, ensure_monitoring_hook,
                          xla_compile_count)
+from .perf import (PerfBaseline, ProgramCostIndex, StepAccounting,
+                   classify_roofline, get_cost_index, implied_mfu,
+                   normalize_cost_analysis, perf_snapshot, set_cost_index,
+                   write_perf_dump)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, set_registry)
-from .slo import (ErrorRateSLO, LatencySLO, SLOWatchdog, TrainingWatch,
-                  get_slo_watchdog, get_training_watch, set_slo_watchdog,
-                  set_training_watch)
+from .slo import (ErrorRateSLO, LatencySLO, SLOWatchdog, ThroughputSLO,
+                  TrainingWatch, get_slo_watchdog, get_training_watch,
+                  set_slo_watchdog, set_training_watch)
 from .spans import (Span, current_span, current_span_path,
                     record_external_span, span)
 from .tracecontext import (TraceContext, adopt, current_trace_context,
@@ -47,8 +51,11 @@ __all__ = [
     "handoff", "adopt", "event",
     "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
     "configure_flight_recorder",
-    "SLOWatchdog", "LatencySLO", "ErrorRateSLO",
+    "SLOWatchdog", "LatencySLO", "ErrorRateSLO", "ThroughputSLO",
     "get_slo_watchdog", "set_slo_watchdog",
+    "ProgramCostIndex", "StepAccounting", "PerfBaseline",
+    "get_cost_index", "set_cost_index", "perf_snapshot", "write_perf_dump",
+    "implied_mfu", "classify_roofline", "normalize_cost_analysis",
     "TrainingWatch", "get_training_watch", "set_training_watch",
     "RecompileDetector", "HostSyncDetector", "HostSyncError",
     "device_memory_gauges", "xla_compile_count", "ensure_monitoring_hook",

@@ -154,6 +154,13 @@ class FlightRecorder:
             "metrics": snap,
             "counter_deltas_since_last_dump": delta,
         }
+        # performance black box (telemetry/perf.py + memprof.py): the
+        # per-program cost/MFU table, step decomposition and the live
+        # memory top-K — an OOM-adjacent incident ships its memory state
+        # with the spans. perf_snapshot never raises; a dump must not
+        # add a second failure to the path that tripped it.
+        from .perf import perf_snapshot
+        record["perf"] = perf_snapshot(reg, top_k=8)
         os.makedirs(self.directory, exist_ok=True)
         stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
         safe_trigger = "".join(ch if (ch.isalnum() or ch in "-_") else "_"

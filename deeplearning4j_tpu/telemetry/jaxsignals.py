@@ -351,8 +351,16 @@ class HostSyncDetector:
 def device_memory_gauges(registry: Optional[MetricsRegistry] = None
                          ) -> Dict[str, float]:
     """Snapshot per-device memory stats into ``device<i>.bytes_in_use`` /
-    ``device<i>.peak_bytes_in_use`` gauges. Returns the values read;
-    backends without memory_stats (CPU) contribute nothing."""
+    ``device<i>.peak_bytes_in_use`` gauges. Returns the values read.
+
+    Backends without ``memory_stats()`` (the CPU test platform) fall back
+    to live-array accounting (telemetry/memprof.py): ``bytes_in_use``
+    becomes the per-device sum of ``jax.live_arrays()`` byte sizes and a
+    ``device<i>.live_arrays_fallback`` marker gauge is set to 1 so a
+    reader can tell allocator truth from accounting estimate — the peak
+    watermark rides the Gauge's built-in ``max`` either way. Before this
+    fallback the memory path silently contributed nothing on CPU, so
+    tier-1 never exercised it."""
     import jax
     reg = registry or get_registry()
     out: Dict[str, float] = {}
@@ -368,4 +376,30 @@ def device_memory_gauges(registry: Optional[MetricsRegistry] = None
                 name = f"device{i}.{key}"
                 reg.gauge(name).set(float(stats[key]))
                 out[name] = float(stats[key])
+    if not out:
+        global _fallback_cache
+        now = time.monotonic()
+        cached_t, per_dev = _fallback_cache
+        if per_dev is None or now - cached_t >= _FALLBACK_MIN_INTERVAL_S:
+            # the walk is O(live arrays) and this runs at every epoch
+            # boundary — a long-lived process (or a test session) can
+            # hold tens of thousands of live arrays, so the WALK is
+            # time-throttled; the gauges are (re)set from the cached
+            # values on every call either way
+            from . import memprof
+            try:
+                per_dev = memprof.live_bytes_by_device()
+            except Exception:   # pragma: no cover - defensive
+                return out
+            _fallback_cache = (now, per_dev)
+        for dev_id, v in per_dev.items():
+            name = f"device{dev_id}.bytes_in_use"
+            reg.gauge(name).set(float(v))
+            reg.gauge(f"device{dev_id}.live_arrays_fallback").set(1.0)
+            out[name] = float(v)
     return out
+
+
+# live-array fallback walk throttle: (last walk monotonic time, values)
+_FALLBACK_MIN_INTERVAL_S = 5.0
+_fallback_cache = (0.0, None)

@@ -1,0 +1,231 @@
+"""Memory profiler: live-array accounting + a black box for OOMs.
+
+``Device.memory_stats()`` answers "how full is the HBM" on real
+accelerator backends — but it is empty on CPU (so tier-1 never exercised
+the memory path) and it never answers "full of WHAT". This module adds
+both halves:
+
+- :func:`snapshot` groups ``jax.live_arrays()`` by (shape, dtype, owner)
+  into a top-K table plus per-device totals — "what is holding my HBM"
+  as one JSON dict, served on ``POST /debug/memprof`` and embedded in
+  every flight-recorder dump so an OOM-adjacent incident leaves a
+  memory black box, not just a stack trace.
+- :func:`tag` records owner hints: call it where long-lived pools are
+  allocated (the Solver tags params, the generation engine tags its KV
+  block pools) and the top-K table labels matching groups with the
+  owner (or the span path active at tag time). Hints are keyed by
+  (shape, dtype) — donation-recycled buffers of the same spec keep
+  their label without per-step re-tagging.
+- :func:`publish_gauges` sets ``memprof.live_bytes`` /
+  ``memprof.live_arrays`` and per-device ``device<i>.live_bytes_in_use``
+  gauges (the Gauge's built-in high-watermark tracks the peak across
+  snapshots) — the live-array fallback ``device_memory_gauges``
+  (jaxsignals.py) uses where ``memory_stats()`` is empty.
+
+Everything here READS (a snapshot walks the live-array list on the
+calling thread — run it from scrape handlers, epoch boundaries or dump
+triggers, not from the dispatch loop); nothing ever forces a device
+sync: shapes/dtypes/nbytes are metadata, no buffer is materialized.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from .registry import MetricsRegistry, get_registry
+from .spans import current_span_path
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["tag", "snapshot", "snapshot_cached", "live_array_groups",
+           "live_bytes_by_device", "publish_gauges", "flightrec_section",
+           "clear_tags"]
+
+# (shape, dtype-str) -> owner label; bounded LRU so a shape-churning run
+# cannot grow it without limit
+_MAX_HINTS = 1024
+_hints: "OrderedDict[Tuple[tuple, str], str]" = OrderedDict()
+_hints_lock = threading.Lock()
+
+
+def tag(tree, owner: Optional[str] = None) -> int:
+    """Record owner hints for every array leaf of ``tree`` (a pytree or
+    a single array). ``owner`` defaults to the active span path — the
+    "owner-span" a later snapshot reports. Returns the number of leaves
+    tagged. Metadata only: never touches a device value."""
+    import jax
+    label = owner or current_span_path() or "untagged"
+    n = 0
+    with _hints_lock:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            key = (tuple(shape), str(dtype))
+            _hints.pop(key, None)
+            _hints[key] = label
+            n += 1
+        while len(_hints) > _MAX_HINTS:
+            _hints.popitem(last=False)
+    return n
+
+
+def clear_tags() -> None:
+    with _hints_lock:
+        _hints.clear()
+
+
+def _owner_for(shape: tuple, dtype: str) -> str:
+    with _hints_lock:
+        return _hints.get((shape, dtype), "?")
+
+
+def live_array_groups(top_k: int = 10) -> List[dict]:
+    """Top-K (shape, dtype, owner) groups of ``jax.live_arrays()`` by
+    total bytes: [{shape, dtype, owner, count, total_bytes}]."""
+    import jax
+    groups: Dict[Tuple[tuple, str], List[float]] = {}
+    for arr in jax.live_arrays():
+        try:
+            key = (tuple(arr.shape), str(arr.dtype))
+            nbytes = float(arr.nbytes)
+        except Exception:       # deleted/donated buffer mid-walk
+            continue
+        rec = groups.setdefault(key, [0.0, 0.0])
+        rec[0] += 1
+        rec[1] += nbytes
+    rows = [{"shape": list(shape), "dtype": dtype,
+             "owner": _owner_for(shape, dtype),
+             "count": int(cnt), "total_bytes": int(total)}
+            for (shape, dtype), (cnt, total) in groups.items()]
+    rows.sort(key=lambda r: -r["total_bytes"])
+    return rows[:top_k]
+
+
+def live_bytes_by_device(arrays=None) -> Dict[int, float]:
+    """Live-array bytes per local device id (a sharded array's bytes are
+    split evenly across its devices). ``arrays`` lets a caller that
+    already fetched ``jax.live_arrays()`` avoid a second walk — the walk
+    is O(live arrays) and a long-lived process can hold tens of
+    thousands."""
+    import jax
+    out: Dict[int, float] = {d.id: 0.0 for d in jax.local_devices()}
+    for arr in (jax.live_arrays() if arrays is None else arrays):
+        try:
+            devs = list(arr.devices())
+            share = float(arr.nbytes) / max(1, len(devs))
+        except Exception:
+            continue
+        for d in devs:
+            out[d.id] = out.get(d.id, 0.0) + share
+    return out
+
+
+def snapshot(top_k: int = 10) -> dict:
+    """One JSON-ready memory profile: total live bytes/arrays, per-device
+    totals (live-array accounting everywhere + ``memory_stats()`` where
+    the backend provides it), and the top-K (shape, dtype, owner) table.
+    ONE walk over the live-array list — this runs at flight-dump and
+    scrape time, where the list can be huge."""
+    import jax
+    arrays = jax.live_arrays()
+    per_dev = live_bytes_by_device(arrays)
+    total = 0.0
+    count = 0
+    groups: Dict[Tuple[tuple, str], List[float]] = {}
+    for arr in arrays:
+        try:
+            key = (tuple(arr.shape), str(arr.dtype))
+            nbytes = float(arr.nbytes)
+        except Exception:       # deleted/donated buffer mid-walk
+            continue
+        total += nbytes
+        count += 1
+        rec = groups.setdefault(key, [0.0, 0.0])
+        rec[0] += 1
+        rec[1] += nbytes
+    top = [{"shape": list(shape), "dtype": dtype,
+            "owner": _owner_for(shape, dtype),
+            "count": int(cnt), "total_bytes": int(tb)}
+           for (shape, dtype), (cnt, tb) in groups.items()]
+    top.sort(key=lambda r: -r["total_bytes"])
+    device_stats = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            device_stats[f"device{dev.id}"] = {
+                k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                      "bytes_limit") if k in stats}
+    return {"total_live_bytes": int(total),
+            "live_arrays": count,
+            "live_bytes_by_device": {f"device{i}": int(v)
+                                     for i, v in sorted(per_dev.items())},
+            "device_stats": device_stats,
+            "top": top[:top_k]}
+
+
+_snap_cache = (0.0, None, 0)       # (monotonic, snapshot, top_k walked)
+_snap_lock = threading.Lock()
+
+
+def snapshot_cached(top_k: int = 10, max_age_s: float = 2.0) -> dict:
+    """:func:`snapshot` with a small time-based cache — the read path
+    for surfaces that poll (``/metrics`` scrapes, repeat-fire flight
+    dumps): the O(live-arrays) walk runs at most once per ``max_age_s``.
+    Use :func:`snapshot` directly where freshness matters (the
+    ``/debug/memprof`` route does)."""
+    import time as _time
+    global _snap_cache
+    now = _time.monotonic()
+    with _snap_lock:
+        t, snap, walked_k = _snap_cache
+        if snap is not None and now - t < max_age_s and walked_k >= top_k:
+            out = dict(snap)
+            out["top"] = snap["top"][:top_k]
+            return out
+    walk_k = max(top_k, 10)
+    snap = snapshot(top_k=walk_k)
+    with _snap_lock:
+        _snap_cache = (now, snap, walk_k)
+    out = dict(snap)
+    out["top"] = snap["top"][:top_k]
+    return out
+
+
+def publish_gauges(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Set ``memprof.live_bytes``/``memprof.live_arrays`` and per-device
+    ``device<i>.live_bytes_in_use`` gauges (each Gauge keeps its own
+    high-watermark — ``max`` is the peak across snapshots). Returns the
+    values set."""
+    import jax
+    reg = registry or get_registry()
+    if not reg.enabled:
+        return {}
+    per_dev = live_bytes_by_device()
+    total = sum(per_dev.values())
+    out = {"memprof.live_bytes": total,
+           "memprof.live_arrays": float(len(jax.live_arrays()))}
+    reg.gauge("memprof.live_bytes").set(total)
+    reg.gauge("memprof.live_arrays").set(out["memprof.live_arrays"])
+    for i, v in per_dev.items():
+        name = f"device{i}.live_bytes_in_use"
+        reg.gauge(name).set(v)
+        out[name] = v
+    return out
+
+
+def flightrec_section(top_k: int = 8) -> Optional[dict]:
+    """Compact memory profile for flight-recorder dumps; returns None
+    instead of raising — the recorder must never add a second failure
+    to the incident that tripped it."""
+    try:
+        return snapshot(top_k=top_k)
+    except Exception as e:        # pragma: no cover - defensive
+        log.debug("memprof flightrec section failed: %s", e)
+        return None

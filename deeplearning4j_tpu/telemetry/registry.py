@@ -139,6 +139,13 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def count_and_sum(self) -> tuple:
+        """(lifetime count, lifetime sum) under ONE lock — delta-based
+        consumers (the perf fold) must not tear the pair against a
+        concurrent observe()."""
+        with self._lock:
+            return self._count, self._sum
+
     @property
     def bounds(self) -> tuple:
         return self._bounds
@@ -220,6 +227,9 @@ class _NullHistogram:
     def count_le_and_total(self, threshold: float) -> tuple:
         return (0, 0)
 
+    def count_and_sum(self) -> tuple:
+        return (0, 0.0)
+
     def percentiles(self) -> Dict[str, float]:
         return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
 
@@ -285,6 +295,23 @@ class MetricsRegistry:
                 h = self._histograms.setdefault(
                     name, Histogram(name, self._histogram_window))
         return h
+
+    # read-only lookups that must not CREATE metrics (the perf fold and
+    # report layers probe for histograms the hot loop may never have
+    # observed — materializing empties would pollute every snapshot)
+    def histogram_if_exists(self, name: str) -> Optional[Histogram]:
+        return self._histograms.get(name)
+
+    def gauge_if_exists(self, name: str) -> Optional[Gauge]:
+        return self._gauges.get(name)
+
+    def gauges_matching(self, prefix: str, suffix: str = ""):
+        """[(name, gauge)] with the given name prefix/suffix (snapshot —
+        safe to iterate while writers register new gauges)."""
+        with self._lock:
+            items = list(self._gauges.items())
+        return [(n, g) for n, g in items
+                if n.startswith(prefix) and n.endswith(suffix)]
 
     # ----------------------------------------------------------- trace events
     def record_event(self, event: dict) -> None:

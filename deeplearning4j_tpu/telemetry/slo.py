@@ -45,7 +45,7 @@ from .registry import MetricsRegistry, get_registry
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["LatencySLO", "ErrorRateSLO", "SLOWatchdog",
+__all__ = ["LatencySLO", "ErrorRateSLO", "ThroughputSLO", "SLOWatchdog",
            "get_slo_watchdog", "set_slo_watchdog",
            "TrainingWatch", "get_training_watch", "set_training_watch",
            "training_health_vec", "HEALTH_LEN"]
@@ -71,6 +71,40 @@ class ErrorRateSLO:
     good: Union[str, Tuple[str, ...]]
     bad: Union[str, Tuple[str, ...]]
     target: float = 0.999
+
+
+@dataclass(frozen=True)
+class ThroughputSLO:
+    """Perf-regression objective: a live throughput/efficiency gauge
+    must not fall below ``ratio_floor`` of the best recorded baseline.
+
+    ``metric`` names a registry GAUGE carrying the live steady-state
+    rate — ``train.windowed_steps_per_sec`` (PerformanceListener),
+    ``generation.<model>.tokens_per_sec``, a ``perf.<path>.mfu`` gauge
+    from the cost index (telemetry/perf.py), or any operator-published
+    rate. ``baseline`` is the best recorded value for the SAME workload
+    — typically ``PerfBaseline.load_trajectory(...).best(row)`` over the
+    checked-in ``BENCH_r*.json`` files, or an operator-pinned number.
+
+    Each watchdog ``check()`` turns the gauge into one good/bad sample
+    using the paired best-of discipline the bench guards use on this
+    noisy rig: the BEST of the last ``best_of`` readings is compared
+    against ``ratio_floor * baseline`` — a co-tenant load burst dents
+    some readings but not the window's best, while a real regression
+    lifts every reading. The good/bad stream then rides the standard
+    multi-window burn-rate machinery (``target`` = the fraction of
+    checks that must pass), so a sustained regression pages through the
+    same breach-edge -> flight-dump path as a latency SLO. A gauge that
+    has never been set (0) contributes NO sample — cold start cannot
+    breach. ``baseline`` <= 0 (row missing from the trajectory) makes
+    the objective report-only: the ratio gauge is published, nothing can
+    breach."""
+    name: str
+    metric: str
+    baseline: float
+    ratio_floor: float = 0.5
+    target: float = 0.9
+    best_of: int = 8
 
 
 def _names(v) -> Tuple[str, ...]:
@@ -126,6 +160,12 @@ class SLOWatchdog:
         self.dump_on_breach = dump_on_breach
         self._samples: Dict[str, deque] = {
             o.name: deque(maxlen=max_samples) for o in self.objectives}
+        # ThroughputSLO state: recent gauge readings (paired best-of
+        # window) + cumulative good/bad totals the burn-rate math reads
+        self._throughput: Dict[str, dict] = {
+            o.name: {"recent": deque(maxlen=o.best_of),
+                     "good": 0, "bad": 0}
+            for o in self.objectives if isinstance(o, ThroughputSLO)}
         self._breached: Dict[str, bool] = {o.name: False
                                            for o in self.objectives}
         self._last: dict = {}
@@ -151,9 +191,36 @@ class SLOWatchdog:
             h = reg.histogram(obj.histogram)
             good, total = h.count_le_and_total(obj.threshold_ms)
             return float(good), float(total - good)
+        if isinstance(obj, ThroughputSLO):
+            return self._throughput_totals(obj)
         good = sum(reg.counter(n).value for n in _names(obj.good))
         bad = sum(reg.counter(n).value for n in _names(obj.bad))
         return float(good), float(bad)
+
+    def _throughput_totals(self, obj: ThroughputSLO) -> Tuple[float, float]:
+        """One good/bad sample per check from the live gauge: best of the
+        recent readings vs ``ratio_floor * baseline`` (paired best-of —
+        the bench-guard discipline for a rig with co-tenant load bursts).
+        An unset gauge adds no sample; an unknown baseline never bads."""
+        reg = self.registry
+        st = self._throughput[obj.name]
+        g = reg.gauge_if_exists(obj.metric)
+        v = float(g.value) if g is not None else 0.0
+        if v > 0:
+            st["recent"].append(v)
+            best = max(st["recent"])
+            if obj.baseline > 0:
+                ratio = best / obj.baseline
+                if reg.enabled:
+                    reg.gauge(f"slo.{obj.name}.throughput_ratio").set(
+                        round(ratio, 4))
+                if ratio >= obj.ratio_floor:
+                    st["good"] += 1
+                else:
+                    st["bad"] += 1
+            else:                      # report-only: no baseline to breach
+                st["good"] += 1
+        return float(st["good"]), float(st["bad"])
 
     # ----------------------------------------------------------------- check
     def check(self, now: Optional[float] = None) -> dict:

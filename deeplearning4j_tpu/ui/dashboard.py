@@ -40,6 +40,7 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>{t_throughput}</h2>{speed_chart}</div>
 <div class="card"><h2>{t_parammag}</h2>{param_chart}</div>
 <div class="card"><h2>{t_ratio}</h2>{ratio_chart}</div>
+{performance_card}
 {telemetry_card}
 {hist_cards}
 {activation_cards}
@@ -151,6 +152,80 @@ def _render_telemetry_card(title: str) -> str:
         "<th>count</th></tr>" + hrows + "</table>") if hrows else ""
     return (f"<div class='card'><h2>{title}</h2>"
             f"<table>{rows}</table>{hist_table}</div>")
+
+
+def _render_performance_card(title: str) -> str:
+    """Performance-observability card (telemetry/perf.py + memprof.py):
+    per-program MFU/roofline rows from the cost index, the step-time
+    decomposition, the live-memory top-K and — when BENCH_r*.json files
+    are present in the working directory — the baseline-delta headline.
+    Empty cost index AND empty decomposition renders nothing (a training
+    run that predates the perf layer keeps its old page)."""
+    from ..telemetry import get_registry
+    from ..telemetry.perf import (PerfBaseline, baseline_deltas,
+                                  get_cost_index, perf_snapshot)
+    reg = get_registry()
+    if not reg.enabled:
+        return ""
+    snap = perf_snapshot(reg, get_cost_index())
+    programs = snap.get("programs") or []
+    decomp = snap.get("step_decomposition") or {}
+    if not programs and not decomp:
+        return ""
+    # headline: the best live MFU + a baseline delta when one is known
+    headline = []
+    with_mfu = [r for r in programs if r.get("mfu") is not None]
+    if with_mfu:
+        best = max(with_mfu, key=lambda r: r["mfu"])
+        headline.append(("best MFU",
+                         f"{best['mfu']:.2%} ({html.escape(best['path'])},"
+                         f" {best['roofline']}-bound)"))
+    try:
+        baseline = PerfBaseline.load_trajectory(".")
+        for d in baseline_deltas(baseline, reg):
+            if d.get("ratio"):
+                headline.append(
+                    (f"vs baseline [{html.escape(d['row'])}]",
+                     f"{d['ratio']:.2f}x of {html.escape(str(d['baseline_file']))}"))
+    except Exception:           # pragma: no cover - defensive
+        pass
+    hrows = "".join(
+        f"<tr><th>{k}</th><td>{v}</td></tr>" for k, v in headline)
+    def _cell(v, pct=False):
+        if v is None:
+            return "-"
+        return f"{v:.2%}" if pct else str(round(v, 4))
+
+    prog_rows = "".join(
+        f"<tr><td>{html.escape(str(r['path']))}</td>"
+        f"<td>{r['roofline']}</td>"
+        f"<td>{_cell(r['step_ms'])}</td>"
+        f"<td>{_cell(r['achieved_tflops'])}</td>"
+        f"<td>{_cell(r['mfu'], pct=True)}</td></tr>"
+        for r in programs)
+    prog_table = ("<table><tr><th>program</th><th>bound</th>"
+                  "<th>step ms</th><th>TFLOP/s</th><th>MFU</th></tr>"
+                  + prog_rows + "</table>") if programs else ""
+    drows = "".join(
+        f"<tr><th>{html.escape(k)}</th><td>{v['p50']}</td>"
+        f"<td>{v['p95']}</td><td>{v['mean']}</td></tr>"
+        for k, v in decomp.items() if isinstance(v, dict) and "p50" in v)
+    decomp_table = ("<table><tr><th></th><th>p50 ms</th><th>p95 ms</th>"
+                    "<th>mean ms</th></tr>" + drows + "</table>") \
+        if drows else ""
+    mem = snap.get("memory") or {}
+    mrows = "".join(
+        f"<tr><td>{html.escape('x'.join(str(d) for d in g['shape']) or '()')}"
+        f"</td><td>{html.escape(g['dtype'])}</td>"
+        f"<td>{html.escape(str(g['owner']))}</td><td>{g['count']}</td>"
+        f"<td>{g['total_bytes']}</td></tr>"
+        for g in (mem.get("top") or [])[:8])
+    mem_table = ("<table><tr><th>shape</th><th>dtype</th><th>owner</th>"
+                 "<th>count</th><th>bytes</th></tr>" + mrows + "</table>") \
+        if mrows else ""
+    return (f"<div class='card'><h2>{title}</h2>"
+            f"<table>{hrows}</table>{prog_table}{decomp_table}{mem_table}"
+            f"</div>")
 
 
 def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,
@@ -295,6 +370,7 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
         speed_chart=_svg_line_chart([("it/s", speed_pts)]),
         param_chart=_svg_line_chart(param_series),
         ratio_chart=_svg_line_chart(ratio_series),
+        performance_card=_render_performance_card(m("train.performance")),
         telemetry_card=_render_telemetry_card(m("train.telemetry")),
         hist_cards=hist_cards,
         activation_cards=activation_cards,

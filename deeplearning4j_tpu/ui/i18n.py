@@ -31,6 +31,7 @@ _EN = {
     "train.graph": "Model graph",
     "train.nodata": "no data yet",
     "train.telemetry": "Runtime telemetry",
+    "train.performance": "Performance (MFU / roofline / memory)",
 }
 
 _MESSAGES: Dict[str, Dict[str, str]] = {
@@ -51,6 +52,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.graph": "Modellgraph",
         "train.nodata": "noch keine Daten",
         "train.telemetry": "Laufzeit-Telemetrie",
+        "train.performance": "Leistung (MFU / Roofline / Speicher)",
     },
     "ja": {
         "train.pagetitle": "トレーニング概要",
@@ -68,6 +70,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.graph": "モデルグラフ",
         "train.nodata": "データなし",
         "train.telemetry": "ランタイムテレメトリ",
+        "train.performance": "パフォーマンス（MFU / ルーフライン / メモリ）",
     },
     "ko": {
         "train.pagetitle": "훈련 개요",
@@ -85,6 +88,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.graph": "모델 그래프",
         "train.nodata": "데이터 없음",
         "train.telemetry": "런타임 텔레메트리",
+        "train.performance": "성능 (MFU / 루프라인 / 메모리)",
     },
     "ru": {
         "train.pagetitle": "Обзор обучения",
@@ -102,6 +106,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.graph": "Граф модели",
         "train.nodata": "данных пока нет",
         "train.telemetry": "Телеметрия выполнения",
+        "train.performance": "Производительность (MFU / roofline / память)",
     },
     "zh": {
         "train.pagetitle": "训练概览",
@@ -119,6 +124,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.graph": "模型图",
         "train.nodata": "暂无数据",
         "train.telemetry": "运行时遥测",
+        "train.performance": "性能（MFU / 屋顶线 / 内存）",
     },
 }
 

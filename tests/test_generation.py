@@ -105,6 +105,21 @@ def shared_lm():
     eng.stop()
 
 
+def test_generation_programs_registered_in_cost_index(shared_lm):
+    """ISSUE 15: warm-up registers every generation executable's XLA cost
+    analysis in the process cost index (decode step paired with the
+    decode_step_ms histogram the scheduler observes; prefill rungs
+    cost-only) — read-only against the shared engine."""
+    from deeplearning4j_tpu.telemetry.perf import get_cost_index
+    idx = get_cost_index()
+    e = idx.get("generation.lm.decode_step")
+    assert e is not None and e.source == "compiled"
+    assert e.flops_per_step and e.flops_per_step > 0
+    assert e.timing_metric == "generation.lm.decode_step_ms"
+    assert any(p.startswith("generation.lm.prefill.")
+               for p in idx.paths())
+
+
 def test_paged_greedy_bit_identical_to_naive_f32(shared_lm):
     """THE pin: greedy decode through the paged KV cache — sequential AND
     continuous-batched concurrent — matches cache-free full-recompute

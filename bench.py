@@ -1360,6 +1360,150 @@ def bench_speculative(duration=None, clients=None, *, k=4, decode_slots=8,
     return out
 
 
+def bench_int8_matmul(repeats=5, *, batch=256):
+    """int8_serving_matmul: the dynamic-quantized serving forward (every
+    Dense matmul through ops/kernels int8 — per-channel weight scales,
+    per-row activation scales, exact int32 accumulate) vs the stock f32
+    forward on the SAME net and batch. Paired best-of device-timed
+    repeats; also reports the max relative error of the int8 logits vs
+    f32 (bounded-error tier — greedy token identity is the quantized KV
+    cache's gate, not this one). On CPU rigs the int8 side runs the XLA
+    fallback (bit-identical math to the fused kernel), so the ratio
+    measures the quantization recipe, not Pallas."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.ops.kernels.quantized import int8_forward_fn
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    K, H, V = 512, 512, 256
+    conf = (NeuralNetConfiguration(seed=7, updater=Sgd(0.1), dtype="float32")
+            .list(DenseLayer(n_in=K, n_out=H, activation="relu"),
+                  DenseLayer(n_out=H, activation="relu"),
+                  OutputLayer(n_out=V, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(17)
+    x = jnp.asarray(rng.standard_normal((batch, K)), jnp.float32)
+
+    fwd_f32 = jax.jit(lambda p, s, xx: net._output_pure(p, s, xx))
+    fwd_int8 = jax.jit(int8_forward_fn(net))
+    y32 = fwd_f32(net.params, net.state, x).block_until_ready()
+    y8 = fwd_int8(net.params, net.state, x).block_until_ready()  # warm
+    rel = float(jnp.max(jnp.abs(y8 - y32) / (jnp.max(jnp.abs(y32)) + 1e-12)))
+
+    def best_of(fn):
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn(net.params, net.state, x).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    pairs = [(best_of(fwd_int8), best_of(fwd_f32)) for _ in range(3)]
+    t8, t32 = min(pairs, key=lambda t: t[0] / t[1])
+    return {
+        "int8_ms": round(t8 * 1e3, 4),
+        "f32_ms": round(t32 * 1e3, 4),
+        "int8_vs_f32_speedup": round(t32 / t8, 3) if t8 else 0.0,
+        "max_rel_err": round(rel, 6),
+        "note": (f"3-layer {K}-{H}-{V} dense serving forward, batch "
+                 f"{batch}, paired best-of-{repeats} device-timed "
+                 "windows; int8 = dynamic per-row activation x static "
+                 "per-channel weight quantization, exact int32 "
+                 "accumulate, one f32 rescale"),
+    }
+
+
+def bench_quantized_kv(duration=None, clients=None, *, decode_slots=8,
+                       max_new=24, prompt_len=8):
+    """quantized_kv_decode: the int8-quantized paged KV pool
+    (quantize-on-write, dequantize-in-attention) vs the f32 pool, paired
+    closed-loop windows at equal offered load on separate engines of the
+    SAME net/config. Reports tokens/sec both modes, the per-token KV
+    footprint of each pool and the capacity-per-byte ratio (ISSUE 17
+    acceptance >= 1.9x), plus a greedy token-parity check between the
+    two modes' outputs on a probe prompt. A nonzero steady-state compile
+    count in either window marks the row invalid (tier-1 bench_smoke
+    asserts zero)."""
+    import threading as _threading
+
+    from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+    from deeplearning4j_tpu.serving import (GenerationEngine,
+                                            xla_compile_count)
+
+    duration = duration or float(os.environ.get("BENCH_QKV_S", "4"))
+    clients = clients or int(os.environ.get("BENCH_GEN_CLIENTS", "8"))
+    net = transformer_lm(vocab_size=128, d_model=64, n_heads=2, n_blocks=2,
+                         max_length=64, seed=123, dtype="float32",
+                         token_input=True).init()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, size=prompt_len).tolist()
+               for _ in range(16)]
+    probe = prompts[0]
+
+    def closed_loop(eng):
+        done = {"tok": 0, "req": 0}
+        lock = _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(tid):
+            k, tok, req = tid, 0, 0
+            while time.perf_counter() < stop_at:
+                toks, _ = eng.generate(prompts[k % len(prompts)],
+                                       max_tokens=max_new, timeout=60.0)
+                tok += len(toks)
+                req += 1
+                k += 1
+            with lock:
+                done["tok"] += tok
+                done["req"] += req
+
+        threads = [_threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done["tok"], done["req"]
+
+    out, probe_tokens = {}, {}
+    for label, dtype in (("int8", "int8"), ("f32", None)):
+        eng = GenerationEngine(
+            net, model_name="lm", block_len=16, max_seq_len=64,
+            decode_slots=decode_slots, queue_limit=4096,
+            prefill_batches=(1, 2, 4), kv_cache_dtype=dtype)
+        probe_tokens[label], _ = eng.generate(probe, max_tokens=max_new,
+                                              temperature=0.0, timeout=60.0)
+        c0 = xla_compile_count()
+        tok, req = closed_loop(eng)
+        compiles = xla_compile_count() - c0
+        info = eng.models()["lm"]
+        eng.stop()
+        out[f"{label}_tokens_per_sec"] = round(tok / duration, 1)
+        out[f"{label}_requests"] = req
+        out[f"{label}_kv_bytes_per_token"] = info["kv_bytes_per_token"]
+        out[f"{label}_steady_state_compiles"] = compiles
+        if compiles:
+            out["invalid_reason"] = (
+                f"{label}: {compiles} steady-state compiles — the "
+                "zero-recompile contract is violated")
+    if out["int8_kv_bytes_per_token"]:
+        out["capacity_per_byte_vs_f32"] = round(
+            out["f32_kv_bytes_per_token"] / out["int8_kv_bytes_per_token"],
+            3)
+    out["greedy_tokens_match"] = int(
+        probe_tokens["int8"] == probe_tokens["f32"])
+    out["note"] = (f"{clients} closed-loop clients, {duration:.0f}s/mode, "
+                   f"prompt {prompt_len}, max_new {max_new}, 2-block d=64 "
+                   "LM; int8 pool = quantize-on-write per-(token,head) "
+                   "symmetric scales, dequantize-in-attention; same "
+                   "num_blocks holds capacity_per_byte_vs_f32 x the "
+                   "tokens per byte")
+    return out
+
+
 def bench_lstm(cell: str = "graves"):
     """LSTM char-RNN training tokens/sec (BASELINE #3 shape: one-hot vocab
     ~87, seq 64, hidden 512, 2 layers). cell='graves' (peepholes, the
@@ -2554,6 +2698,8 @@ def main():
             ("serving_throughput", bench_serving),
             ("generate_tokens_per_sec", bench_generate),
             ("speculative_decode", bench_speculative),
+            ("int8_serving_matmul", bench_int8_matmul),
+            ("quantized_kv_decode", bench_quantized_kv),
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overlap", bench_collective_overlap),
             ("zero_sharded_update", bench_zero_sharded_update),

@@ -99,6 +99,18 @@ class ConvolutionLayer(LayerConf):
         return y + params["b"] if self.has_bias else y
 
     def apply(self, params, state, x, *, train=False, rng=None):
+        # fused conv1x1+bias+relu helper probe (the reference's cuDNN
+        # helper seam, ConvolutionLayer.java:72, done the registry way)
+        from ...ops.kernels.conv import (conv1x1_bias_relu,
+                                         conv1x1_bias_relu_applicable)
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = self._geom()
+        if self.has_bias and "b" in params and x.ndim == 4 and \
+                conv1x1_bias_relu_applicable(
+                    (kh, kw), (sh, sw), (dh, dw), (ph, pw),
+                    self.convolution_mode, True, self.activation,
+                    int(x.shape[-1]), int(params["W"].shape[-1]), x.dtype):
+            x = maybe_dropout(x, self.dropout, rng, train)
+            return conv1x1_bias_relu(x, params["W"], params["b"]), state
         return self.act(self.pre_output(params, x, train=train, rng=rng)), state
 
 

@@ -1,0 +1,80 @@
+"""Shared kill-switch / interpret-mode env plumbing for the kernel library.
+
+Before ISSUE 17 each fused kernel carried its own copy of the same three
+decisions (pallas_attention.py, pallas_lstm.py, pallas_compression.py):
+
+  1. is the kernel env-disabled?     (kill switch, default ENABLED)
+  2. may CPU run the interpreter?    (parity-test opt-in, default OFF)
+  3. does the backend admit the kernel at all?
+
+This module is the single home for those rules. Canonical names:
+
+    DL4J_TPU_KERNEL_<NAME>            "0"/"false"/"off" kills the kernel
+    DL4J_TPU_KERNEL_<NAME>_INTERPRET  "1"/"true"/"on" opts CPU into the
+                                      pallas interpreter (parity tests)
+
+The pre-registry names (``DL4J_TPU_FUSED_ATTENTION``, ``DL4J_TPU_FUSED_
+LSTM``, ``DL4J_TPU_FUSED_ENCODE`` and their ``*_INTERPRET`` partners) stay
+honored as aliases — first-set-wins, canonical name first — so every
+existing script, conftest default, and runbook keeps working
+(regression-pinned in tests/test_kernel_registry.py).
+
+Import layering: this module is import-light (os only, no jax) so the
+pallas_* modules can use it without pulling the registry (which imports
+them) into a cycle.
+"""
+from __future__ import annotations
+
+import os
+from typing import Sequence, Tuple
+
+_KILL_VALUES = ("0", "false", "off")
+_ON_VALUES = ("1", "true", "on")
+
+
+def kill_env_name(name: str) -> str:
+    return "DL4J_TPU_KERNEL_" + name.upper()
+
+
+def interpret_env_name(name: str) -> str:
+    return kill_env_name(name) + "_INTERPRET"
+
+
+def _first_set(names: Sequence[str]):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return None
+
+
+def fused_enabled(name: str, aliases: Tuple[str, ...] = ()) -> bool:
+    """Kill-switch check: kernels default ENABLED; the canonical
+    ``DL4J_TPU_KERNEL_<NAME>`` wins over legacy aliases when both are
+    set (first-set-wins over [canonical, *aliases])."""
+    v = _first_set((kill_env_name(name),) + tuple(aliases))
+    if v is None:
+        return True
+    return v.strip().lower() not in _KILL_VALUES
+
+
+def interpret_opted_in(name: str, aliases: Tuple[str, ...] = ()) -> bool:
+    """Interpreter opt-in: default OFF — pallas interpret mode on CPU is
+    orders of magnitude slower than the XLA fallbacks, so only parity
+    tests want it."""
+    v = _first_set((interpret_env_name(name),) + tuple(aliases))
+    if v is None:
+        return False
+    return v.strip().lower() in _ON_VALUES
+
+
+def backend_admits(name: str, backend: str,
+                   interpret_aliases: Tuple[str, ...] = ()) -> bool:
+    """The shared backend rule: TPU always runs the fused kernel; CPU runs
+    it only under the interpreter opt-in; anything else (gpu, ...) falls
+    back — the kernels are TPU-shaped."""
+    if backend == "tpu":
+        return True
+    if backend == "cpu":
+        return interpret_opted_in(name, interpret_aliases)
+    return False

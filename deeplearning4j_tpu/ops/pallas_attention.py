@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .kernels import envutil as kenv
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -63,7 +65,7 @@ def _kernel_eligible(D: int, dtype) -> bool:
     top."""
     if not PALLAS_AVAILABLE:
         return False
-    if os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") == "0":
+    if not kenv.fused_enabled("attention", ("DL4J_TPU_FUSED_ATTENTION",)):
         return False
     dt = jnp.dtype(dtype)
     if dt not in (jnp.float32, jnp.dtype(jnp.bfloat16)):
@@ -74,13 +76,8 @@ def _kernel_eligible(D: int, dtype) -> bool:
         # padding — the MXU pads the QK^T contraction to 128 either way,
         # so the only cost is padded q/k/v/o tiles in VMEM
         return False
-    backend = jax.default_backend()
-    if backend == "tpu":
-        return True
-    if backend == "cpu":
-        # interpreter is for parity tests only (see ops/pallas_lstm.py)
-        return os.environ.get("DL4J_TPU_FUSED_ATTN_INTERPRET", "0") == "1"
-    return False
+    return kenv.backend_admits("attention", jax.default_backend(),
+                               ("DL4J_TPU_FUSED_ATTN_INTERPRET",))
 
 
 def fused_attention_applicable(B: int, H: int, T: int, D: int, dtype) -> bool:
@@ -95,10 +92,12 @@ def _interpret() -> bool:
 
 
 def _blocks(T: int) -> tuple:
-    """(BQ, BK) block sizes. Defaults come from the v5e autotune sweep
-    (tools/autotune_attention.py; see BASELINE.md's attention roofline
-    note — the same preference order won at every head dim tried);
-    DL4J_TPU_ATTN_BQ / DL4J_TPU_ATTN_BK override for re-tuning."""
+    """(BQ, BK) block sizes. Resolution order: explicit env override
+    (DL4J_TPU_ATTN_BQ / DL4J_TPU_ATTN_BK, for re-tuning sweeps) → a cached
+    autotune decision for this (T, backend) from ops/kernels/autotune.py →
+    the v5e-sweep defaults (tools/autotune_attention.py; see BASELINE.md's
+    attention roofline note — the same preference order won at every head
+    dim tried)."""
     def pick(env, pref):
         v = os.environ.get(env)
         if v:
@@ -118,6 +117,14 @@ def _blocks(T: int) -> tuple:
     # compile with it, so 512/1024 is the stable optimum.
     pref_q = (512, 256, 128)
     pref_k = (1024, 512, 256, 128)
+    if os.environ.get("DL4J_TPU_ATTN_BQ") is None and \
+            os.environ.get("DL4J_TPU_ATTN_BK") is None:
+        from .kernels import autotune   # lazy: avoids an import cycle
+        cached = autotune.cached_decision("attention", f"T{T}")
+        if cached is not None:
+            bq, bk = int(cached[0]), int(cached[1])
+            if T % bq == 0 and T % bk == 0:
+                return bq, bk
     return pick("DL4J_TPU_ATTN_BQ", pref_q), pick("DL4J_TPU_ATTN_BK", pref_k)
 
 

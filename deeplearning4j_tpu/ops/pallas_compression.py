@@ -36,6 +36,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from .kernels import envutil as kenv
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -56,7 +58,7 @@ def fused_threshold_encode_applicable(n: int, dtype) -> bool:
     fall back to the XLA elementwise path when False.)"""
     if not PALLAS_AVAILABLE:
         return False
-    if os.environ.get("DL4J_TPU_FUSED_ENCODE", "1") == "0":
+    if not kenv.fused_enabled("threshold_encode", ("DL4J_TPU_FUSED_ENCODE",)):
         return False
     dt = jnp.dtype(dtype)
     if dt not in (jnp.float32, jnp.dtype(jnp.bfloat16)):
@@ -65,13 +67,8 @@ def fused_threshold_encode_applicable(n: int, dtype) -> bool:
         # below one block the pallas_call overhead beats the fusion win;
         # XLA fuses the tiny elementwise encode into its consumer anyway
         return False
-    backend = jax.default_backend()
-    if backend == "tpu":
-        return True
-    if backend == "cpu":
-        # interpreter is for parity tests only (tests/conftest.py)
-        return os.environ.get("DL4J_TPU_FUSED_ENCODE_INTERPRET", "0") == "1"
-    return False
+    return kenv.backend_admits("threshold_encode", jax.default_backend(),
+                               ("DL4J_TPU_FUSED_ENCODE_INTERPRET",))
 
 
 def _interpret() -> bool:

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .kernels import envutil as kenv
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -58,7 +60,7 @@ def fused_lstm_applicable(B: int, H: int, dtype, *, peepholes, mask,
     combinations run fused."""
     if not PALLAS_AVAILABLE:
         return False
-    if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
+    if not kenv.fused_enabled("lstm", ("DL4J_TPU_FUSED_LSTM",)):
         return False
     if reverse:
         # the kernels are forward-only; a reverse caller must flip inputs/
@@ -75,14 +77,8 @@ def fused_lstm_applicable(B: int, H: int, dtype, *, peepholes, mask,
         return False
     if H % 128 != 0 or B % min_b != 0 or H > _MAX_FUSED_H:
         return False
-    backend = jax.default_backend()
-    if backend == "tpu":
-        return True
-    if backend == "cpu":
-        # interpret mode is orders of magnitude slower than the scan
-        # fallback — only the parity tests want it (opt-in via env var)
-        return os.environ.get("DL4J_TPU_FUSED_LSTM_INTERPRET", "0") == "1"
-    return False
+    return kenv.backend_admits("lstm", jax.default_backend(),
+                               ("DL4J_TPU_FUSED_LSTM_INTERPRET",))
 
 
 def _interpret() -> bool:

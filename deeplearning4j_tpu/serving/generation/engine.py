@@ -190,6 +190,8 @@ class GenerationEngine:
             "in_flight": rt.in_flight,
             "queue_depth": rt.queue_depth,
             "prefix_cache": rt.active_ps.prefix_enabled,
+            "kv_cache_dtype": rt.config.kv_cache_dtype,
+            "kv_bytes_per_token": rt.active_ps.kv_bytes_per_token(),
             "speculative": {
                 "enabled": rt.active_ps.spec_k > 0,
                 "k": rt.active_ps.spec_k,

@@ -23,8 +23,9 @@ protocol.
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, NamedTuple, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..errors import BlockPoolExhaustedError
@@ -114,11 +115,54 @@ class BlockAllocator:
         return self._refcount.get(b, 0)
 
 
+class QuantizedPool(NamedTuple):
+    """int8-quantized block pool (ISSUE 17): the same
+    [n_layers, num_blocks, block_len, n_heads, *] geometry, with each
+    (token, head) vector stored as int8 codes plus ONE f32 scale —
+    2*(Dh+4) bytes per token/layer/head instead of f32's 8*Dh, so the
+    same ``num_blocks`` holds ~2-3.5x the tokens per byte (and every
+    prefix-cache hit shares the smaller blocks). A NamedTuple is a pytree,
+    so the cache stays the 2-tuple ``(k_entry, v_entry)`` the warmed
+    programs, donation, and ``_cache_spec`` already handle."""
+    q: jnp.ndarray        # int8 [n_layers, nb, blk, H, Dh]
+    scale: jnp.ndarray    # f32  [n_layers, nb, blk, H]
+
+
+def kv_quantize(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., Dh] → (int8 codes [..., Dh], f32 scales [...]) — symmetric
+    per-(token, head) scales. DETERMINISTIC: prefill, decode, replay and
+    verify all quantize through this exact expression, which is what makes
+    quantized greedy decode self-consistent token-for-token across the
+    hit/miss/speculative paths."""
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def make_pools(n_layers: int, num_blocks: int, block_len: int,
-               n_heads: int, head_dim: int, dtype) -> Tuple:
-    """Zero-filled (k_pool, v_pool)."""
+               n_heads: int, head_dim: int, dtype,
+               quantized: bool = False) -> Tuple:
+    """Zero-filled (k_pool, v_pool) — plain arrays, or ``QuantizedPool``
+    pairs when ``quantized`` (the kv_cache_dtype="int8" tier)."""
     shape = (n_layers, num_blocks, block_len, n_heads, head_dim)
+    if quantized:
+        def qp():
+            return QuantizedPool(jnp.zeros(shape, jnp.int8),
+                                 jnp.zeros(shape[:-1], jnp.float32))
+        return qp(), qp()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def pool_bytes(pool) -> int:
+    """Total device bytes of one pool entry (plain array or QuantizedPool)."""
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(pool))
 
 
 def cow_copy(k_pool, v_pool, src, dst):
@@ -126,27 +170,102 @@ def cow_copy(k_pool, v_pool, src, dst):
     ``dst`` — the copy-on-write primitive for prefix sharing. ``src``/
     ``dst`` are runtime int32 scalars, so ONE compiled program serves every
     copy; functional update keeps the read-before-write ordering a data
-    dependency."""
-    k_pool = k_pool.at[:, dst].set(k_pool[:, src])
-    v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+    dependency. Generic over plain and quantized pools (a quantized COW
+    copies codes AND scales — bit-exact sharing)."""
+    copy = lambda p: p.at[:, dst].set(p[:, src])
+    k_pool = jax.tree_util.tree_map(copy, k_pool)
+    v_pool = jax.tree_util.tree_map(copy, v_pool)
     return k_pool, v_pool
 
 
 def prefill_scatter(pool, layer_kv, tables):
     """Write a prefill's K or V for one layer into the pool.
 
-    pool      [n_layers, nb, blk, H, Dh] (functional update)
+    pool      [n_layers, nb, blk, H, Dh] (functional update; plain or
+              ``QuantizedPool`` — quantized pools quantize-on-write)
     layer_kv  list of [P, L, H, Dh] per layer (L % blk == 0)
     tables    [P, max_blocks] int32 — first L//blk entries are the
               sequence's blocks (rest point at trash block 0).
     """
     P, L, H, Dh = layer_kv[0].shape
+    if isinstance(pool, QuantizedPool):
+        blk = pool.q.shape[2]
+        nblk = L // blk
+        qp, sp = pool
+        for i, kv in enumerate(layer_kv):
+            q, s = kv_quantize(kv)
+            qp = qp.at[i, tables[:, :nblk]].set(
+                q.reshape(P, nblk, blk, H, Dh))
+            sp = sp.at[i, tables[:, :nblk]].set(
+                s.reshape(P, nblk, blk, H))
+        return QuantizedPool(qp, sp)
     blk = pool.shape[2]
     nblk = L // blk
     for i, kv in enumerate(layer_kv):
         pool = pool.at[i, tables[:, :nblk]].set(
             kv.reshape(P, nblk, blk, H, Dh))
     return pool
+
+
+def _pool_write(pool, i, bid, off, tok):
+    """Scatter one layer's token (or window) K/V at (bid, off) — the
+    quantize-on-write seam. ``tok`` [..., H, Dh] with leading [S] or
+    [S, W] index shape matching bid/off."""
+    if isinstance(pool, QuantizedPool):
+        q, s = kv_quantize(tok)
+        return QuantizedPool(pool.q.at[i, bid, off].set(q),
+                             pool.scale.at[i, bid, off].set(s))
+    return pool.at[i, bid, off].set(tok)
+
+
+def _pool_gather(pool, i, tables, S, ctx_len, H, Dh, dtype):
+    """Gather the full context for one layer → [S, H, ctx, Dh] — the
+    dequantize-in-attention seam."""
+    if isinstance(pool, QuantizedPool):
+        ctx = pool.q[i][tables].reshape(S, ctx_len, H, Dh)
+        sc = pool.scale[i][tables].reshape(S, ctx_len, H)
+        ctx = kv_dequantize(ctx, sc, dtype)
+    else:
+        ctx = pool[i][tables].reshape(S, ctx_len, H, Dh)
+    return ctx.transpose(0, 2, 1, 3)
+
+
+class QuantSimStore:
+    """Full-prompt window store for the int8-KV PREFILL: records each
+    layer's raw K/V (for the quantize-on-write scatter afterwards) and
+    serves attention the FAKE-QUANTIZED context — dequantize(quantize(k))
+    — with the causal row mask.
+
+    Why it exists: a prefix-cache hit skips prefill and replays the
+    unmatched suffix through the one-token decode program, whose
+    attention sees dequantized int8 K/V. If prefill computed its logits
+    from full-precision K/V, hit and miss paths would diverge token-for-
+    token. Running the prefill through ``decode_window`` with this store
+    makes row ``i`` see exactly what a decode step at position ``i``
+    would read back from the quantized pool (quantization is
+    deterministic, so the scatter stores the identical codes) — the
+    quantized engine is self-consistent across prefill / decode / replay
+    / speculative verify."""
+
+    def __init__(self, n_layers: int):
+        self.ks: List = [None] * n_layers
+        self.vs: List = [None] * n_layers
+
+    def put_get(self, i: int, k_win, v_win):
+        """k_win/v_win: [B, W, H, Dh]. Returns (K [B,H,W,Dh],
+        V [B,H,W,Dh], causal row_mask [B,W,W])."""
+        self.ks[i] = k_win
+        self.vs[i] = v_win
+        B, W = k_win.shape[:2]
+
+        def fakeq(x):
+            q, s = kv_quantize(x)
+            return kv_dequantize(q, s, x.dtype).transpose(0, 2, 1, 3)
+
+        mask = (jnp.arange(W)[None, None, :]
+                <= jnp.arange(W)[None, :, None])
+        mask = jnp.broadcast_to(mask, (B, W, W))
+        return fakeq(k_win), fakeq(v_win), mask
 
 
 class PagedStore:
@@ -174,15 +293,14 @@ class PagedStore:
 
     def put_get(self, i: int, k_tok, v_tok):
         S = k_tok.shape[0]
-        self.k_pool = self.k_pool.at[i, self._bid, self._off].set(k_tok)
-        self.v_pool = self.v_pool.at[i, self._bid, self._off].set(v_tok)
         H, Dh = k_tok.shape[-2:]
-
-        def gathered(pool):
-            ctx = pool[i][self.tables]          # [S, mb, blk, H, Dh]
-            return ctx.reshape(S, self._ctx_len, H, Dh).transpose(0, 2, 1, 3)
-
-        return gathered(self.k_pool), gathered(self.v_pool), self._mask
+        self.k_pool = _pool_write(self.k_pool, i, self._bid, self._off, k_tok)
+        self.v_pool = _pool_write(self.v_pool, i, self._bid, self._off, v_tok)
+        K = _pool_gather(self.k_pool, i, self.tables, S, self._ctx_len,
+                         H, Dh, k_tok.dtype)
+        V = _pool_gather(self.v_pool, i, self.tables, S, self._ctx_len,
+                         H, Dh, v_tok.dtype)
+        return K, V, self._mask
 
     @property
     def pools(self):
@@ -223,15 +341,14 @@ class PagedWindowStore:
         """k_win/v_win: [S, W, H, Dh] for the window. Returns
         (K [S,H,ctx,Dh], V [S,H,ctx,Dh], row_mask [S,W,ctx])."""
         S = k_win.shape[0]
-        self.k_pool = self.k_pool.at[i, self._bid, self._off].set(k_win)
-        self.v_pool = self.v_pool.at[i, self._bid, self._off].set(v_win)
         H, Dh = k_win.shape[-2:]
-
-        def gathered(pool):
-            ctx = pool[i][self.tables]          # [S, mb, blk, H, Dh]
-            return ctx.reshape(S, self._ctx_len, H, Dh).transpose(0, 2, 1, 3)
-
-        return gathered(self.k_pool), gathered(self.v_pool), self._mask
+        self.k_pool = _pool_write(self.k_pool, i, self._bid, self._off, k_win)
+        self.v_pool = _pool_write(self.v_pool, i, self._bid, self._off, v_win)
+        K = _pool_gather(self.k_pool, i, self.tables, S, self._ctx_len,
+                         H, Dh, k_win.dtype)
+        V = _pool_gather(self.v_pool, i, self.tables, S, self._ctx_len,
+                         H, Dh, v_win.dtype)
+        return K, V, self._mask
 
     @property
     def pools(self):

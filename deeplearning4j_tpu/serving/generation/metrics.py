@@ -43,6 +43,7 @@ class GenerationMetrics:
         self.decode_recompiles = 0
         self.slots = 0
         self.blocks_total = 0
+        self.kv_bytes_per_token = None          # quantized-KV tier (ISSUE 17)
         # prefix-cache economics (ISSUE 14)
         self._ttft_cached_ms = deque(maxlen=window)
         self.prefix_hits = 0
@@ -259,6 +260,18 @@ class GenerationMetrics:
         with self._lock:
             self.decode_recompiles = n
 
+    def set_kv_bytes_per_token(self, v) -> None:
+        """Block-pool bytes per token slot (the quantized-KV capacity
+        currency); None (state adapter) publishes nothing."""
+        if v is None:
+            return
+        with self._lock:
+            self.kv_bytes_per_token = float(v)
+        reg = self.registry
+        if reg.enabled:
+            reg.gauge(f"generation.{self.name}.kv_bytes_per_token").set(
+                float(v))
+
     def _recent_tokens_per_sec(self, now: float, window_s: float = 5.0):
         if not self._tok_t:
             return 0.0
@@ -312,6 +325,7 @@ class GenerationMetrics:
                 "rejected": dict(self.rejected),
                 "hot_swaps": self.swaps,
                 "decode_recompiles": self.decode_recompiles,
+                "kv_bytes_per_token": self.kv_bytes_per_token,
                 "uptime_s": round(now - self._t0, 1),
                 # block-pool economics: who is sharing, what the cache
                 # holds, what COW and eviction cost

@@ -33,7 +33,8 @@ import numpy as np
 
 from ...models.decode import LSTMDecodeSpec, TransformerDecodeSpec
 from ..programs import _arch_key, _tree_signature
-from .kvcache import PagedStore, make_pools, prefill_scatter
+from .kvcache import (PagedStore, QuantSimStore, make_pools,
+                      prefill_scatter)
 from .sampling import sample_tokens
 
 
@@ -67,10 +68,18 @@ class GenerationConfig:
     # speculative decoding: draft proposals per verify window; 0 with a
     # draft model attached defaults to 4 at program-set construction
     spec_k: int = 0
+    # quantized KV tier (ISSUE 17): "int8" stores the paged block pool as
+    # int8 codes + per-(token, head) f32 scales — quantize-on-write /
+    # dequantize-in-attention inside the warmed programs, so the same
+    # num_blocks holds ~2x+ the tokens per byte. None = full precision.
+    kv_cache_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.block_len < 1 or self.decode_slots < 1:
             raise ValueError("block_len and decode_slots must be >= 1")
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype must be None or 'int8', got "
+                             f"{self.kv_cache_dtype!r}")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0")
         self.capacity = _ceil_to(self.max_seq_len, self.block_len)
@@ -149,6 +158,13 @@ class GenerationProgramSet:
                                if config.prefix_cache is None
                                else bool(config.prefix_cache)
                                and self.adapter == "paged")
+        # int8-quantized KV tier: paged pools only (the state adapter's
+        # carry is recurrent state, not a token cache)
+        self.kv_quantized = config.kv_cache_dtype == "int8"
+        if self.kv_quantized and self.adapter != "paged":
+            raise ValueError("kv_cache_dtype='int8' requires the paged "
+                             "(transformer) adapter — the state adapter "
+                             "has no KV block pool to quantize")
         # speculative decoding: active iff a draft model is attached
         self.draft_net = draft_net
         self.spec_k = 0
@@ -182,7 +198,8 @@ class GenerationProgramSet:
                           self.adapter, config.block_len, config.capacity,
                           config.decode_slots, config.prefill_batches,
                           config.prompt_rungs, config.num_blocks,
-                          self.prefix_enabled, draft_sig)
+                          self.prefix_enabled, config.kv_cache_dtype,
+                          draft_sig)
         self._compiled: Dict[Any, Any] = {}
         if self.adapter == "state":
             self._init_states = self.spec.init_states(config.decode_slots + 1)
@@ -209,7 +226,8 @@ class GenerationProgramSet:
         if self.adapter == "paged":
             cache = make_pools(self.spec.n_blocks, c.num_blocks,
                                c.block_len, self.spec.n_heads,
-                               self.spec.head_dim, self.dtype)
+                               self.spec.head_dim, self.dtype,
+                               quantized=self.kv_quantized)
         else:
             cache = jax.tree.map(jnp.zeros_like, self._init_states)
         try:     # memprof owner hint: the block pool dominates live HBM
@@ -222,6 +240,20 @@ class GenerationProgramSet:
 
     def fresh_key(self):
         return jax.random.PRNGKey(self.config.seed)
+
+    def kv_bytes_per_token(self) -> Optional[float]:
+        """Block-pool device bytes per token SLOT (K + V, all layers/
+        heads) — the capacity-per-byte currency the quantized tier
+        moves; published as ``generation.<m>.kv_bytes_per_token``.
+        None for the state adapter (no token-addressed pool)."""
+        if self.adapter != "paged":
+            return None
+        s = self.spec
+        if self.kv_quantized:
+            per_head = s.head_dim * 1 + 4          # int8 codes + f32 scale
+        else:
+            per_head = s.head_dim * jnp.dtype(self.dtype).itemsize
+        return float(2 * s.n_blocks * s.n_heads * per_head)
 
     def make_draft_cache(self):
         """Fresh draft cache: dense per-slot K/V for a transformer draft,
@@ -246,7 +278,21 @@ class GenerationProgramSet:
                 self._trace_hook()
             if self.adapter == "paged":
                 k_pool, v_pool = cache
-                logits, ks, vs = spec.prefill_forward(params, state, tokens)
+                if self.kv_quantized:
+                    # int8 tier: compute the prefill logits through FAKE-
+                    # QUANTIZED attention (QuantSimStore) so the first
+                    # sampled token matches what a decode-step replay of
+                    # the same prompt would produce — the prefix-cache
+                    # hit path replays the unmatched suffix through the
+                    # decode program, and both must see identical K/V
+                    store = QuantSimStore(spec.n_blocks)
+                    logits = spec.decode_window(
+                        params, state, tokens,
+                        jnp.zeros((tokens.shape[0],), jnp.int32), store)
+                    ks, vs = store.ks, store.vs
+                else:
+                    logits, ks, vs = spec.prefill_forward(params, state,
+                                                          tokens)
                 k_pool = prefill_scatter(k_pool, ks, tables)
                 v_pool = prefill_scatter(v_pool, vs, tables)
                 last = jnp.take_along_axis(

@@ -177,6 +177,7 @@ class ModelRuntime:
         self.swap_lock = threading.Lock()
         self.config = ps.config
         self.metrics = metrics or GenerationMetrics(name=name)
+        self.metrics.set_kv_bytes_per_token(ps.kv_bytes_per_token())
         S = self.config.decode_slots
         self._queue: "deque[_GenRequest]" = deque()
         self._cond = threading.Condition()

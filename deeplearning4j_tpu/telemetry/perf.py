@@ -631,6 +631,14 @@ def perf_snapshot(registry: Optional[MetricsRegistry] = None,
     except Exception as e:          # pragma: no cover - defensive
         log.debug("perf snapshot failed: %s", e)
     try:
+        # kernel library (ISSUE 17): registered kernels, active impl,
+        # autotune decisions — lazy import so telemetry never forces the
+        # ops package (and a broken kernel module never costs a dump)
+        from ..ops.kernels import kernels_snapshot
+        out["kernels"] = kernels_snapshot()
+    except Exception as e:          # pragma: no cover - defensive
+        log.debug("kernels snapshot failed: %s", e)
+    try:
         # cached walk (~2 s max staleness) by default: /metrics scrapes
         # and repeat-fire dump triggers must not pay a fresh
         # O(live-arrays) walk each. ``fresh_memory=True`` forces the

@@ -154,7 +154,53 @@ def _render_telemetry_card(title: str) -> str:
             f"<table>{rows}</table>{hist_table}</div>")
 
 
-def _render_performance_card(title: str) -> str:
+def _render_kernels_table(reg, snap, heading: str) -> str:
+    """Per-kernel rows for the Performance card (ISSUE 17): which impl is
+    live (fused / interpret / fallback), the block choice actually in use
+    (an autotuned decision when one is cached for this rig, else the
+    hand-tuned default), and measured-vs-roofline from the
+    ``perf.kernels.<name>.*`` gauges — below-bound kernels flagged."""
+    kernels = snap.get("kernels") or {}
+    if not kernels:
+        return ""
+
+    def _g(name):
+        g = reg.gauge_if_exists(name)
+        return g.value if g is not None else None
+
+    rows = []
+    for name in sorted(kernels):
+        k = kernels[name]
+        choice = k.get("default_choice")
+        src = "default"
+        for rec in (k.get("autotune") or {}).values():
+            if rec.get("choice"):
+                choice, src = rec["choice"], "autotuned"
+                break
+        blocks = ("x".join(str(v) for v in choice) if choice else "-") \
+            + (f" ({src})" if choice else "")
+        base = f"perf.kernels.{name}"
+        ratio = _g(f"{base}.vs_roofline")
+        below = _g(f"{base}.below_roofline")
+        if ratio:
+            vs = f"{ratio:.2f}x bound"
+            if below:
+                vs += " &#9888;"          # below-roofline warning sign
+        else:
+            vs = "-"
+        impl = k.get("impl", "?")
+        if not k.get("enabled", True):
+            impl += " (killed)"
+        rows.append(f"<tr><td>{html.escape(name)}</td>"
+                    f"<td>{html.escape(impl)}</td>"
+                    f"<td>{html.escape(blocks)}</td>"
+                    f"<td>{vs}</td></tr>")
+    return (f"<h3>{heading}</h3>"
+            "<table><tr><th>kernel</th><th>impl</th><th>blocks</th>"
+            "<th>vs roofline</th></tr>" + "".join(rows) + "</table>")
+
+
+def _render_performance_card(title: str, kernels_heading: str = "Kernels") -> str:
     """Performance-observability card (telemetry/perf.py + memprof.py):
     per-program MFU/roofline rows from the cost index, the step-time
     decomposition, the live-memory top-K and — when BENCH_r*.json files
@@ -223,9 +269,10 @@ def _render_performance_card(title: str) -> str:
     mem_table = ("<table><tr><th>shape</th><th>dtype</th><th>owner</th>"
                  "<th>count</th><th>bytes</th></tr>" + mrows + "</table>") \
         if mrows else ""
+    kern_table = _render_kernels_table(reg, snap, kernels_heading)
     return (f"<div class='card'><h2>{title}</h2>"
-            f"<table>{hrows}</table>{prog_table}{decomp_table}{mem_table}"
-            f"</div>")
+            f"<table>{hrows}</table>{prog_table}{kern_table}"
+            f"{decomp_table}{mem_table}</div>")
 
 
 def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = None,
@@ -370,7 +417,8 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
         speed_chart=_svg_line_chart([("it/s", speed_pts)]),
         param_chart=_svg_line_chart(param_series),
         ratio_chart=_svg_line_chart(ratio_series),
-        performance_card=_render_performance_card(m("train.performance")),
+        performance_card=_render_performance_card(
+            m("train.performance"), kernels_heading=m("train.kernels")),
         telemetry_card=_render_telemetry_card(m("train.telemetry")),
         hist_cards=hist_cards,
         activation_cards=activation_cards,

@@ -32,6 +32,7 @@ _EN = {
     "train.nodata": "no data yet",
     "train.telemetry": "Runtime telemetry",
     "train.performance": "Performance (MFU / roofline / memory)",
+    "train.kernels": "Kernels (impl / blocks / roofline)",
 }
 
 _MESSAGES: Dict[str, Dict[str, str]] = {
@@ -53,6 +54,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.nodata": "noch keine Daten",
         "train.telemetry": "Laufzeit-Telemetrie",
         "train.performance": "Leistung (MFU / Roofline / Speicher)",
+        "train.kernels": "Kernel (Implementierung / Blöcke / Roofline)",
     },
     "ja": {
         "train.pagetitle": "トレーニング概要",
@@ -71,6 +73,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.nodata": "データなし",
         "train.telemetry": "ランタイムテレメトリ",
         "train.performance": "パフォーマンス（MFU / ルーフライン / メモリ）",
+        "train.kernels": "カーネル（実装 / ブロック / ルーフライン）",
     },
     "ko": {
         "train.pagetitle": "훈련 개요",
@@ -89,6 +92,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.nodata": "데이터 없음",
         "train.telemetry": "런타임 텔레메트리",
         "train.performance": "성능 (MFU / 루프라인 / 메모리)",
+        "train.kernels": "커널 (구현 / 블록 / 루프라인)",
     },
     "ru": {
         "train.pagetitle": "Обзор обучения",
@@ -107,6 +111,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.nodata": "данных пока нет",
         "train.telemetry": "Телеметрия выполнения",
         "train.performance": "Производительность (MFU / roofline / память)",
+        "train.kernels": "Ядра (реализация / блоки / roofline)",
     },
     "zh": {
         "train.pagetitle": "训练概览",
@@ -125,6 +130,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.nodata": "暂无数据",
         "train.telemetry": "运行时遥测",
         "train.performance": "性能（MFU / 屋顶线 / 内存）",
+        "train.kernels": "内核（实现 / 块 / 屋顶线）",
     },
 }
 

@@ -5,6 +5,7 @@ Mirrors the reference's test stance (SURVEY.md §4): the CPU backend is the
 xla_force_host_platform_device_count=8 (the analogue of Spark local[n]).
 """
 import os
+import tempfile
 
 # Force-override: the sandbox presets JAX_PLATFORMS=axon (the real TPU) and
 # its sitecustomize imports jax at interpreter startup, so the env var has
@@ -17,6 +18,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DL4J_TPU_FUSED_LSTM_INTERPRET", "1")
 os.environ.setdefault("DL4J_TPU_FUSED_ATTN_INTERPRET", "1")
 os.environ.setdefault("DL4J_TPU_FUSED_ENCODE_INTERPRET", "1")
+# Isolate the autotune decision cache from any user-level file: pinned
+# block-size expectations (e.g. attention _blocks defaults) must not be
+# overridden by stray decisions cached on this machine.
+os.environ.setdefault(
+    "DL4J_TPU_AUTOTUNE_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="dl4j-autotune-"), "autotune.json"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -341,7 +341,10 @@ def test_deadline_mid_stream_terminates_cleanly(shared_lm):
     'deadline' — the consumer's iteration ENDS (no hang), partial tokens
     stand, and the slot/blocks are released for the next request."""
     net, spec, eng = shared_lm
-    st = eng.generate([1, 2, 3], max_tokens=60, timeout=0.02,
+    # 8ms: long enough to clear admission + one warmed prefill, short
+    # enough that no rig decodes all 60 tokens first (each step syncs a
+    # token readback) — the deadline must win, whatever the machine speed
+    st = eng.generate([1, 2, 3], max_tokens=60, timeout=0.008,
                       stream=True)
     toks = list(st)                      # must terminate on its own
     assert st.finish_reason == "deadline"
@@ -350,14 +353,18 @@ def test_deadline_mid_stream_terminates_cleanly(shared_lm):
     # the slot is free again: a normal request completes afterwards
     toks2, reason = eng.generate([4, 5], max_tokens=3)
     assert (len(toks2), reason) == (3, "length")
-    assert eng.metrics()["lm"]["finished"].get("deadline", 0) >= 1
+    # mid-generation expiry counts as finished; a (rare, loaded-rig)
+    # expiry while still queued counts as rejected — either terminates
+    m = eng.metrics()["lm"]
+    assert (m["finished"].get("deadline", 0)
+            + m["rejected"].get("deadline", 0)) >= 1
 
 
 def test_drain_and_stop_semantics():
     """drain=True completes in-flight + queued work then refuses new
     submissions (503); drain=False terminates everything NOW — either way
     every stream finishes and no caller hangs."""
-    net = _lm(seed=53, vocab=29, d_model=16, n_blocks=1, max_length=64)
+    net = _lm(seed=53, vocab=29, d_model=16, n_blocks=1, max_length=256)
     eng = GenerationEngine(net, model_name="lm", block_len=8, max_seq_len=64,
                            decode_slots=1, prefill_batches=(1,),
                            prompt_rungs=(64,))
@@ -368,15 +375,17 @@ def test_drain_and_stop_semantics():
     with pytest.raises(DrainingError):
         eng.generate([1], max_tokens=1)
 
+    # 250 tokens of runway: no rig finishes them inside the 10ms window,
+    # so stop(drain=False) always lands mid-flight
     eng2 = GenerationEngine(net, model_name="lm", block_len=8,
-                            max_seq_len=64, decode_slots=1,
+                            max_seq_len=256, decode_slots=1,
                             prefill_batches=(1,), prompt_rungs=(64,))
-    st2 = eng2.generate([1, 2], max_tokens=60, stream=True)
+    st2 = eng2.generate([1, 2], max_tokens=250, stream=True)
     time.sleep(0.01)                       # let it get in flight
     eng2.stop(drain=False, timeout=5.0)
     toks2 = list(st2)                      # terminates, partial or empty
     assert st2.finish_reason == "shutdown"
-    assert len(toks2) < 60
+    assert len(toks2) < 250
 
 
 def test_prefill_failure_fails_caller_and_engine_recovers():

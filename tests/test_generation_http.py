@@ -213,8 +213,11 @@ def test_http_midstream_deadline_terminates_cleanly():
     base = f"http://127.0.0.1:{srv.start()}"
     try:
         t0 = time.monotonic()
+        # 5ms: small enough that a warm rig cannot emit all 60 tokens
+        # (prefill alone approaches it) — the deadline must land before
+        # "length" does, whatever the machine speed
         lines = _stream(base, {"prompt": [1, 2, 3], "max_tokens": 60,
-                               "timeout_ms": 25}, timeout=15)
+                               "timeout_ms": 5}, timeout=15)
         elapsed = time.monotonic() - t0
         assert lines[-1]["done"] is True
         assert lines[-1]["reason"] == "deadline"

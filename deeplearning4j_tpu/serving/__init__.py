@@ -21,6 +21,12 @@ Pillars:
                  rejection counters; XLA compile counter
   - http.py      /predict /health /metrics /models /reload with real
                  status codes (400/404/429/500/503/504)
+  - fleet/       elastic multi-process replica pool: supervised replica
+                 processes behind a prefix-cache-affinity router with
+                 health-gated admission, SLO-driven autoscaling, and
+                 persistent-compilation-cache cold start (import
+                 ``deeplearning4j_tpu.serving.fleet`` — kept out of this
+                 namespace so single-process serving stays light)
 """
 from .buckets import BucketLadder
 from .batcher import ShapeBucketedBatcher

@@ -204,6 +204,31 @@ class GenerationEngine:
             rts = list(self._models.values())
         return {rt.name: rt.queue_depth for rt in rts}
 
+    def steering(self) -> dict:
+        """Per-model routing signals + the worst-case aggregate a fleet
+        router steers on (``/health``'s ``steering`` key): total queue
+        depth, max slot occupancy, min block-pool free fraction, and the
+        request-weighted prefix hit rate across models."""
+        with self._lock:
+            rts = list(self._models.values())
+        per = {rt.name: rt.steering() for rt in rts}
+        rows = list(per.values())
+        hits = sum(r["prefix_hit_rate"] * r["prefix_lookups"] for r in rows)
+        lookups = sum(r["prefix_lookups"] for r in rows)
+        return {
+            "queue_depth": sum(r["queue_depth"] for r in rows),
+            "in_flight": sum(r["in_flight"] for r in rows),
+            "slot_occupancy": max(
+                (r["slot_occupancy"] for r in rows), default=0.0),
+            "block_pool_free_frac": min(
+                (r["block_pool_free_frac"] for r in rows), default=1.0),
+            "prefix_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+            "prefix_lookups": lookups,
+            "block_len": per.get(self._default, {}).get(
+                "block_len", rows[0]["block_len"] if rows else None),
+            "models": per,
+        }
+
     def publish_metrics(self, storage, session_id: str = "generation"):
         with self._lock:
             rts = list(self._models.values())

@@ -211,6 +211,35 @@ class ModelRuntime:
     def draining(self) -> bool:
         return self._draining
 
+    def steering(self) -> dict:
+        """Cheap routing signals for the fleet router (the ``/health``
+        steering payload — the router must not scrape full ``/metrics``
+        per admission): prefix hit rate, instantaneous decode-slot
+        occupancy, block-pool free fraction and queue depth, plus the
+        ``block_len`` the affinity hash needs. Lock-free reads of ints
+        under the GIL — a slightly torn snapshot only mis-routes one
+        request, it cannot corrupt anything."""
+        cfg = self.config
+        coh = self._cohorts[-1] if self._cohorts else None
+        free = coh.allocator.free_blocks if coh is not None \
+            else cfg.num_blocks
+        m = self.metrics
+        lookups = m.prefix_hits + m.prefix_misses
+        in_flight = len(self._slot_req)
+        return {
+            "queue_depth": len(self._queue),
+            "in_flight": in_flight,
+            "decode_slots": cfg.decode_slots,
+            "slot_occupancy": round(in_flight / cfg.decode_slots, 4),
+            "block_len": cfg.block_len,
+            "blocks_total": cfg.num_blocks,
+            "block_pool_free_frac": (round(free / cfg.num_blocks, 4)
+                                     if cfg.num_blocks else 1.0),
+            "prefix_hit_rate": (round(m.prefix_hits / lookups, 4)
+                                if lookups else 0.0),
+            "prefix_lookups": lookups,
+        }
+
     def submit(self, prompt, *, max_new: int, temperature: float = 0.0,
                top_k: int = 0, stop: Sequence[int] = (),
                timeout: Optional[float] = None,

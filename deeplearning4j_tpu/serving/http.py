@@ -92,7 +92,7 @@ def _error_body(exc: BaseException) -> dict:
 class ServingHTTPServer:
     def __init__(self, engine: Optional[InferenceEngine] = None,
                  port: int = 0, host: str = "127.0.0.1", *,
-                 generation=None):
+                 generation=None, health_extra=None):
         if engine is None and generation is None:
             raise ValueError("need an InferenceEngine and/or a "
                              "GenerationEngine to serve")
@@ -102,6 +102,9 @@ class ServingHTTPServer:
         self._port = port
         self._httpd = None
         self._thread = None
+        # extra keys merged into every /health body — the fleet replica
+        # wrapper publishes its identity + cold-start accounting there
+        self._health_extra = health_extra
 
     @property
     def port(self) -> int:
@@ -113,6 +116,7 @@ class ServingHTTPServer:
         from ..util.httpjson import read_json, write_json
         engine = self.engine
         generation = self.generation
+        health_extra = self._health_extra
 
         class Handler(hs.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"    # required for chunked replies
@@ -173,6 +177,16 @@ class ServingHTTPServer:
                     if generation is not None:
                         body["generation_models"] = generation.names()
                         body["generation_queue_depth"] = gdepths
+                        # steering payload (ISSUE 18): the fleet router's
+                        # admission signals — prefix hit rate, slot
+                        # occupancy, block-pool free fraction — WITHOUT
+                        # the cost of a full /metrics scrape per route
+                        body["steering"] = generation.steering()
+                    if health_extra is not None:
+                        try:
+                            body.update(health_extra())
+                        except Exception:   # pragma: no cover - defensive
+                            pass
                     write_json(self, 503 if draining else 200, body)
                 elif self.path == "/metrics":
                     body = engine.metrics() if engine else {}

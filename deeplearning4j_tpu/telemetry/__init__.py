@@ -24,7 +24,7 @@ from .flightrec import (FlightRecorder, configure_flight_recorder,
                         get_flight_recorder, set_flight_recorder)
 from .jaxsignals import (HostSyncDetector, HostSyncError, RecompileDetector,
                          device_memory_gauges, ensure_monitoring_hook,
-                         xla_compile_count)
+                         xla_cache_hit_count, xla_compile_count)
 from .perf import (PerfBaseline, ProgramCostIndex, StepAccounting,
                    classify_roofline, get_cost_index, implied_mfu,
                    normalize_cost_analysis, perf_snapshot, set_cost_index,
@@ -58,7 +58,8 @@ __all__ = [
     "implied_mfu", "classify_roofline", "normalize_cost_analysis",
     "TrainingWatch", "get_training_watch", "set_training_watch",
     "RecompileDetector", "HostSyncDetector", "HostSyncError",
-    "device_memory_gauges", "xla_compile_count", "ensure_monitoring_hook",
+    "device_memory_gauges", "xla_compile_count", "xla_cache_hit_count",
+    "ensure_monitoring_hook",
     "reset",
 ]
 

@@ -40,15 +40,18 @@ from .spans import _EPOCH_NS, current_span, current_span_path
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["xla_compile_count", "ensure_monitoring_hook",
+__all__ = ["xla_compile_count", "xla_cache_hit_count",
+           "ensure_monitoring_hook",
            "RecompileDetector", "HostSyncDetector", "HostSyncError",
            "device_memory_gauges"]
 
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _hook_lock = threading.Lock()
 _hook_installed = False
 _compile_count = 0
+_cache_hit_count = 0
 _compile_subscribers: List[Callable[[str, float], None]] = []
 
 
@@ -84,9 +87,23 @@ def ensure_monitoring_hook() -> None:
             for cb in list(_compile_subscribers):
                 cb(path, secs)
 
+        def _on_event(name, **kw):
+            # persistent-compilation-cache hits: on this jax line the
+            # backend_compile duration event fires even when the
+            # executable was LOADED from the cache, so "how many programs
+            # did this process freshly compile" is compiles MINUS hits —
+            # the cold-start pin (serving/fleet/coldstart.py) reads both
+            global _cache_hit_count
+            if name == _CACHE_HIT_EVENT:
+                _cache_hit_count += 1
+                reg = get_registry()
+                if reg.enabled:
+                    reg.counter("jax.compile_cache_hits").inc()
+
         # jax 0.4.x registers but cannot unregister a listener; one
         # fan-out installed once per process dispatches to subscribers.
         jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        jax.monitoring.register_event_listener(_on_event)
         _hook_installed = True
 
 
@@ -96,6 +113,16 @@ def xla_compile_count() -> int:
     increase means something recompiled)."""
     ensure_monitoring_hook()
     return _compile_count
+
+
+def xla_cache_hit_count() -> int:
+    """Process-wide persistent-compilation-cache hit count. A program
+    answered from the cache still fires the backend-compile duration
+    event on this jax line, so ``xla_compile_count() -
+    xla_cache_hit_count()`` is the number of FRESH compiles — the
+    cold-start acceptance pin."""
+    ensure_monitoring_hook()
+    return _cache_hit_count
 
 
 _STDLIB_DIR = None

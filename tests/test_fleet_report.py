@@ -1,0 +1,133 @@
+"""tools/fleet_report.py: folding fleet /metrics snapshots into a report.
+
+Stdlib-only CLI (no jax import), same stance as tools/perf_report.py —
+tested on fake snapshots shaped like FleetHTTPServer's GET /metrics:
+per-replica fold, aggregate hit rate weighting, later-wins merge across
+snapshot files, text/JSON rendering, and the bad-input exit code.
+"""
+import json
+import os
+import sys
+
+
+def _tool():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import fleet_report
+    return fleet_report
+
+
+def _snap():
+    return {
+        "policy": "affinity", "block_len": 16,
+        "requests": 40, "retries": 2, "streams_lost": 1,
+        "replica_deaths": 1, "rejected": 0,
+        "affinity": {"entries": 6, "capacity": 8192,
+                     "entries_per_replica": {"f0": 4, "f1": 2}},
+        "replicas": {
+            "f0": {"id": "f0", "state": "ready", "restarts": 0,
+                   "consecutive_failures": 0, "forwarded": 30,
+                   "steering": {"queue_depth": 2, "in_flight": 1,
+                                "slot_occupancy": 0.5,
+                                "block_pool_free_frac": 0.8,
+                                "prefix_hit_rate": 0.9,
+                                "prefix_lookups": 30}},
+            "f1": {"id": "f1", "state": "dead", "restarts": 1,
+                   "consecutive_failures": 3, "forwarded": 10,
+                   "steering": {"queue_depth": 0, "in_flight": 0,
+                                "slot_occupancy": 0.0,
+                                "block_pool_free_frac": 1.0,
+                                "prefix_hit_rate": 0.3,
+                                "prefix_lookups": 10}},
+        },
+        "replica_metrics": {
+            "f0": {"generation": {"lm": {"ttft_ms": {"p50": 12.0,
+                                                     "p99": 40.0}}}},
+        },
+    }
+
+
+def test_fold_rows_totals_and_aggregate_hit_rate():
+    fr = _tool()
+    report = fr.fold(_snap())
+    rows = {r["id"]: r for r in report["rows"]}
+    assert rows["f0"]["hit_rate"] == 0.9
+    assert rows["f0"]["ttft_p50_ms"] == 12.0
+    assert rows["f1"]["ttft_p99_ms"] is None
+    t = report["totals"]
+    assert t["replicas"] == 2 and t["ready"] == 1
+    assert t["forwarded"] == 40 and t["queue"] == 2
+    assert t["restarts"] == 1
+    # request-weighted: (0.9*30 + 0.3*10) / 40
+    assert t["aggregate_hit_rate"] == 0.75
+    assert report["counters"]["retries"] == 2
+
+
+def test_merge_later_snapshot_wins_per_replica():
+    fr = _tool()
+    before = _snap()
+    after = _snap()
+    after["replicas"] = {"f1": {**before["replicas"]["f1"],
+                                "state": "ready", "restarts": 2}}
+    merged = fr.merge_snapshots([before, after])
+    assert set(merged["replicas"]) == {"f0", "f1"}
+    assert merged["replicas"]["f1"]["state"] == "ready"
+    assert merged["replicas"]["f1"]["restarts"] == 2
+    assert merged["replicas"]["f0"]["state"] == "ready"
+
+
+def test_render_is_one_aligned_table():
+    fr = _tool()
+    out = fr.render(fr.fold(_snap()))
+    assert "policy=affinity" in out
+    lines = out.splitlines()
+    assert any(l.lstrip().startswith("f0") for l in lines)
+    assert any("TOTAL" in l for l in lines)
+    assert any("retries=2" in l for l in lines)
+    assert "affinity map: 6/8192" in out and "f0:4" in out
+
+
+def test_main_text_json_and_merge(tmp_path, capsys):
+    fr = _tool()
+    p1 = tmp_path / "a.json"
+    p1.write_text(json.dumps(_snap()))
+    assert fr.main([str(p1)]) == 0
+    assert "TOTAL" in capsys.readouterr().out
+    assert fr.main([str(p1), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["totals"]["aggregate_hit_rate"] == 0.75
+    # two files merge
+    p2 = tmp_path / "b.json"
+    snap2 = _snap()
+    snap2["replicas"]["f1"]["state"] = "ready"
+    p2.write_text(json.dumps(snap2))
+    assert fr.main([str(p1), str(p2), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["totals"]["ready"] == 2
+
+
+def test_main_rejects_bad_input(tmp_path, capsys):
+    fr = _tool()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not": "a snapshot"}))
+    assert fr.main([str(bad)]) == 2
+    assert "fleet_report" in capsys.readouterr().err
+    assert fr.main([str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+def test_tool_stays_importable_without_the_package():
+    """Same discipline as perf_report: operators run this against a prod
+    dump on a box with no jax — the module must not import the package."""
+    import ast
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fleet_report.py")
+    tree = ast.parse(open(path).read())
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods |= {a.name.split(".")[0] for a in node.names}
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mods.add(node.module.split(".")[0])
+    assert "deeplearning4j_tpu" not in mods
+    assert "jax" not in mods and "numpy" not in mods

@@ -513,8 +513,11 @@ def _swap_soak(n_swaps: int, clients: int, max_new: int):
         eng.stop()
 
 
+@pytest.mark.slow   # tier-1 keeps the hot-swap contract via
+# test_hot_swap_cutover_in_flight_on_old_params; the 20-swap soak below
+# covers the under-load interleaving
 def test_hot_swap_under_decode_soak_fast():
-    """Tier-1 fast variant of the hot-swap-under-decode soak: swaps land
+    """Fast variant of the hot-swap-under-decode soak: swaps land
     while clients stream; every result must match ONE of the two param
     sets exactly — never a mixture."""
     _swap_soak(n_swaps=3, clients=3, max_new=12)

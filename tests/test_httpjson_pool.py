@@ -1,0 +1,157 @@
+"""util/httpjson.py HTTPClient: keep-alive pooling regression surface.
+
+The fleet router forwards every request through this client, so the pool
+invariants are load-bearing serving behavior, not plumbing detail: a
+sequential caller must ride ONE socket (the socket-reuse pin), a stale
+pooled connection must cost one silent retry (never a caller-visible
+error), a fresh-connection failure must propagate (it is real), and only
+fully-read streams may return their connection to the pool.
+"""
+import http.server
+import json
+import socket
+import threading
+
+import pytest
+
+from deeplearning4j_tpu.util.httpjson import HTTPClient
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"       # keep-alive is the point
+
+    def setup(self):
+        super().setup()
+        with self.server.lock:
+            self.server.connections += 1
+            self.server.sockets.append(self.connection)
+
+    def _reply(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):   # noqa: N802
+        with self.server.lock:
+            self.server.hits += 1
+            hits = self.server.hits
+        self._reply({"path": self.path, "hits": hits})
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        self._reply({"echo": json.loads(self.rfile.read(n) or b"{}")})
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve(port=0):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    srv.connections = 0
+    srv.hits = 0
+    srv.lock = threading.Lock()
+    srv.sockets = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop(srv):
+    """Stop the listener AND force-close accepted keep-alive sockets —
+    shutdown() alone leaves handler threads serving pooled connections."""
+    srv.shutdown()
+    srv.server_close()
+    with srv.lock:
+        socks = list(srv.sockets)
+    for s in socks:
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        s.close()
+
+
+def test_sequential_requests_reuse_one_socket():
+    srv, base = _serve()
+    client = HTTPClient(max_per_host=4, timeout=5.0)
+    try:
+        for i in range(6):
+            status, body = client.request_json("GET", base + f"/r{i}")
+            assert status == 200 and body["path"] == f"/r{i}"
+        stats = client.stats()
+        # the pin: one TCP handshake for the whole sequence
+        assert stats["connections_created"] == 1
+        assert stats["reused"] == 5
+        assert stats["pooled_idle"] == 1
+        assert srv.connections == 1     # server agrees: one accept()
+    finally:
+        client.close()
+        _stop(srv)
+
+
+def test_stale_pooled_connection_retried_once():
+    """Server restart invalidates the pooled socket; the next request
+    must succeed on a silent fresh-connection retry."""
+    srv, base = _serve()
+    port = srv.server_address[1]
+    client = HTTPClient(max_per_host=2, timeout=5.0)
+    try:
+        status, _ = client.request_json("GET", base + "/warm")
+        assert status == 200
+        assert client.stats()["pooled_idle"] == 1
+        _stop(srv)                      # pooled socket is now stale
+        srv, base = _serve(port)        # same port, new listener
+        status, body = client.request_json("GET", base + "/after")
+        assert status == 200 and body["path"] == "/after"
+        # exactly one extra connection: the stale one was retried, the
+        # failure never reached the caller
+        assert client.stats()["connections_created"] == 2
+    finally:
+        client.close()
+        _stop(srv)
+
+
+def test_fresh_connection_failure_propagates():
+    # grab a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    client = HTTPClient(timeout=2.0)
+    try:
+        with pytest.raises(OSError):
+            client.request_json("GET", f"http://127.0.0.1:{port}/x")
+    finally:
+        client.close()
+
+
+def test_stream_read_to_eof_returns_connection_to_pool():
+    srv, base = _serve()
+    client = HTTPClient(timeout=5.0)
+    try:
+        with client.stream("GET", base + "/s") as resp:
+            assert resp.status == 200
+            resp.read()                 # fully consumed
+        assert client.stats()["pooled_idle"] == 1
+        client.request_json("GET", base + "/again")
+        assert client.stats()["connections_created"] == 1
+    finally:
+        client.close()
+        _stop(srv)
+
+
+def test_abandoned_stream_closes_socket():
+    srv, base = _serve()
+    client = HTTPClient(timeout=5.0)
+    try:
+        with client.stream("GET", base + "/s"):
+            pass                        # body never read: suspect socket
+        assert client.stats()["pooled_idle"] == 0
+        client.request_json("GET", base + "/next")
+        assert client.stats()["connections_created"] == 2
+    finally:
+        client.close()
+        _stop(srv)

@@ -245,6 +245,9 @@ def test_quiesce_invariant_catches_leak(cache_lm):
     rt._check_quiesce()
 
 
+@pytest.mark.slow   # bf16 variant; tier-1 keeps the f32 pin
+# (test_cached_prefix_bit_identical_f32) and the core bf16 decode pin
+# (test_generation.py::test_paged_greedy_bit_identical_dtypes_and_embeds)
 def test_cached_prefix_bit_identical_bf16():
     """Same exactness pin in bf16 (COW + partial-match replay)."""
     net = _lm(seed=11, vocab=37, d_model=16, n_blocks=1, max_length=32,

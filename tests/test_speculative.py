@@ -225,6 +225,9 @@ def test_speculative_lstm_draft_bit_identical():
         eng.stop()
 
 
+@pytest.mark.slow   # bf16 variant; tier-1 keeps the f32 pin
+# (test_speculative_greedy_bit_identical_f32) and the core bf16 decode
+# pin (test_generation.py::test_paged_greedy_bit_identical_dtypes_and_embeds)
 def test_speculative_bf16_bit_identical():
     net = _lm(seed=13, vocab=37, d_model=16, n_blocks=2, max_length=32,
               dtype="bfloat16")

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""One-page fleet report from front-door /metrics snapshots.
+
+    python tools/fleet_report.py fleet_metrics.json
+    python tools/fleet_report.py snap1.json snap2.json   # merged
+    curl -s localhost:8400/metrics | python tools/fleet_report.py -
+    python tools/fleet_report.py fleet_metrics.json --json
+
+Reads the JSON the fleet front door serves on ``GET /metrics`` — the
+router's membership/affinity/counter block plus the per-replica
+``/metrics`` scrapes under ``"replica_metrics"`` — and folds it into one
+aligned per-replica table:
+
+  - state, restarts, consecutive failures, forwarded requests
+  - steering signals: queue depth, in-flight, decode-slot occupancy,
+    block-pool free fraction
+  - prefix-cache hit rate (per replica AND the fleet aggregate — the
+    number affinity routing exists to raise)
+  - generation latency p50/p99 when the replica scrape carries them
+
+plus a totals row and the router's own counters (requests, retries,
+streams_lost, replica_deaths, rejected). Multiple snapshot files merge
+by replica id (later files win), so dumps taken before and after an
+incident diff in one invocation.
+
+Like the other tools/ CLIs this must stay importable without the
+package: stdlib only, no jax, no numpy.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_snapshot(path: str) -> dict:
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    snap = json.loads(text)
+    if not isinstance(snap, dict) or "replicas" not in snap:
+        raise ValueError(f"{path}: not a fleet /metrics snapshot "
+                         "(no 'replicas' key)")
+    return snap
+
+
+def merge_snapshots(snaps: List[dict]) -> dict:
+    """Later snapshots win per replica id; counters come from the last."""
+    out = dict(snaps[-1])
+    replicas: Dict[str, dict] = {}
+    scraped: Dict[str, dict] = {}
+    for s in snaps:
+        replicas.update(s.get("replicas") or {})
+        scraped.update(s.get("replica_metrics") or {})
+    out["replicas"] = replicas
+    out["replica_metrics"] = scraped
+    return out
+
+
+def _gen_latency(scrape: Optional[dict]) -> Dict[str, Optional[float]]:
+    """Pull generation p50/p99 out of a replica /metrics scrape (first
+    generation model's ttft histogram) — best effort, shape-tolerant."""
+    out: Dict[str, Optional[float]] = {"p50": None, "p99": None}
+    gen = (scrape or {}).get("generation")
+    if not isinstance(gen, dict):
+        return out
+    for row in gen.values():
+        if not isinstance(row, dict):
+            continue
+        h = row.get("ttft_ms")
+        if isinstance(h, dict) and "p50" in h:
+            out["p50"], out["p99"] = h.get("p50"), h.get("p99")
+            return out
+    return out
+
+
+def fold(snap: dict) -> dict:
+    """The report's data model: per-replica rows + totals + counters."""
+    rows = []
+    scraped = snap.get("replica_metrics") or {}
+    for rid, r in sorted((snap.get("replicas") or {}).items()):
+        s = r.get("steering") or {}
+        lookups = s.get("prefix_lookups", 0) or 0
+        lat = _gen_latency(scraped.get(rid))
+        rows.append({
+            "id": rid,
+            "state": r.get("state", "?"),
+            "restarts": r.get("restarts", 0),
+            "fails": r.get("consecutive_failures", 0),
+            "forwarded": r.get("forwarded", 0),
+            "queue": s.get("queue_depth", 0),
+            "in_flight": s.get("in_flight", 0),
+            "occupancy": s.get("slot_occupancy"),
+            "pool_free": s.get("block_pool_free_frac"),
+            "hit_rate": s.get("prefix_hit_rate"),
+            "lookups": lookups,
+            "ttft_p50_ms": lat["p50"],
+            "ttft_p99_ms": lat["p99"],
+        })
+    lookups = sum(r["lookups"] for r in rows)
+    hits = sum((r["hit_rate"] or 0.0) * r["lookups"] for r in rows)
+    totals = {
+        "replicas": len(rows),
+        "ready": sum(1 for r in rows if r["state"] == "ready"),
+        "forwarded": sum(r["forwarded"] for r in rows),
+        "queue": sum(r["queue"] for r in rows),
+        "in_flight": sum(r["in_flight"] for r in rows),
+        "restarts": sum(r["restarts"] for r in rows),
+        "aggregate_hit_rate": round(hits / lookups, 4) if lookups else None,
+    }
+    counters = {k: snap.get(k) for k in
+                ("requests", "retries", "streams_lost", "replica_deaths",
+                 "rejected") if k in snap}
+    return {"policy": snap.get("policy"),
+            "block_len": snap.get("block_len"),
+            "affinity": snap.get("affinity"),
+            "rows": rows, "totals": totals, "counters": counters}
+
+
+def _fmt(v, width: int, frac: bool = False) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if frac:
+        return f"{v:.3f}".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.1f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def render(report: dict) -> str:
+    cols = (("replica", 10), ("state", 9), ("fwd", 6), ("queue", 6),
+            ("infl", 5), ("occ", 6), ("free", 6), ("hit", 6),
+            ("p50ms", 7), ("p99ms", 7), ("rst", 4), ("fail", 5))
+    lines = [f"fleet report — policy={report['policy']} "
+             f"block_len={report['block_len']}",
+             "  ".join(name.rjust(w) for name, w in cols),
+             "  ".join("-" * w for _, w in cols)]
+    for r in report["rows"]:
+        lines.append("  ".join((
+            _fmt(r["id"], 10), _fmt(r["state"], 9),
+            _fmt(r["forwarded"], 6), _fmt(r["queue"], 6),
+            _fmt(r["in_flight"], 5), _fmt(r["occupancy"], 6, True),
+            _fmt(r["pool_free"], 6, True), _fmt(r["hit_rate"], 6, True),
+            _fmt(r["ttft_p50_ms"], 7), _fmt(r["ttft_p99_ms"], 7),
+            _fmt(r["restarts"], 4), _fmt(r["fails"], 5))))
+    t = report["totals"]
+    lines.append("  ".join((
+        _fmt("TOTAL", 10), _fmt(f"{t['ready']}/{t['replicas']}", 9),
+        _fmt(t["forwarded"], 6), _fmt(t["queue"], 6),
+        _fmt(t["in_flight"], 5), _fmt(None, 6),
+        _fmt(None, 6), _fmt(t["aggregate_hit_rate"], 6, True),
+        _fmt(None, 7), _fmt(None, 7), _fmt(t["restarts"], 4),
+        _fmt(None, 5))))
+    if report["counters"]:
+        lines.append("router: " + "  ".join(
+            f"{k}={v}" for k, v in report["counters"].items()))
+    aff = report.get("affinity")
+    if isinstance(aff, dict):
+        per = aff.get("entries_per_replica") or {}
+        lines.append(
+            f"affinity map: {aff.get('entries', 0)}/"
+            f"{aff.get('capacity', '?')} entries"
+            + ("  (" + ", ".join(f"{k}:{v}" for k, v in sorted(per.items()))
+               + ")" if per else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold fleet /metrics snapshots into one table")
+    ap.add_argument("paths", nargs="+",
+                    help="fleet /metrics JSON files ('-' for stdin)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded report as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        snaps = [load_snapshot(p) for p in args.paths]
+    except (OSError, ValueError) as e:
+        print(f"fleet_report: {e}", file=sys.stderr)
+        return 2
+    report = fold(merge_snapshots(snaps))
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

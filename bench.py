@@ -756,9 +756,16 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
         telemetry off. K=8/batch 32 is the accounting's design point:
         capture is once per program, decomposition appends are per
         WINDOW, and the fold runs at epoch boundaries.
-    The <5% acceptance bound on all four is enforced by the tier-1
+    ISSUE 19 addition, same paired-best-of discipline:
+      - fleet_collector_overhead_pct: the fleet-observability layer — a
+        FleetCollector pulling the trace ring + raw metrics on a 50ms
+        period plus a TraceSpool spilling to disk, both sharing the
+        serving process's cores — vs the same traced closed loop with
+        neither running (telemetry enabled in both modes: this isolates
+        the collector+spool marginal cost).
+    The <5% acceptance bound on all five is enforced by the tier-1
     bench_smoke guards (tests/test_telemetry.py, tests/test_tracing.py,
-    tests/test_perf.py)."""
+    tests/test_perf.py, tests/test_fleet_collector.py)."""
     from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
     from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
@@ -810,10 +817,11 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
                  "bare8": (False, False, 8, traced_batch)}
     # ``variants`` lets the tier-1 guards pay only for what they assert
     # (the base guard predates the traced/serving variants)
-    unknown = set(variants) - {"base", "traced", "serving", "perf"}
+    unknown = set(variants) - {"base", "traced", "serving", "perf",
+                               "fleet"}
     if unknown or not variants:
         raise ValueError(f"unknown variants {sorted(unknown)} "
-                         f"(choose from base/traced/serving/perf)")
+                         f"(choose from base/traced/serving/perf/fleet)")
     modes = ()
     if "base" in variants:
         modes += (True, False)
@@ -911,6 +919,9 @@ def bench_telemetry_overhead(steps=None, repeats=None, serving_requests=None,
     if "serving" in variants:
         out.update(_telemetry_serving_overhead(
             make_net(), serving_requests, max(3, repeats - 2)))
+    if "fleet" in variants:
+        out.update(_fleet_collector_overhead(
+            make_net(), serving_requests, max(3, repeats - 2)))
     return out
 
 
@@ -991,6 +1002,99 @@ def _telemetry_serving_overhead(net, n_requests, repeats, clients=4):
             "serving_traced_req_per_sec":
             round(total / float(np.min(times[True])), 1),
             "serving_bare_req_per_sec":
+            round(total / float(np.min(times[False])), 1)}
+
+
+def _fleet_collector_overhead(net, n_requests, repeats, clients=4):
+    """fleet_collector_overhead_pct (ISSUE 19): the marginal cost of the
+    FULL fleet-observability layer — a FleetCollector pulling the
+    replica's trace ring + raw metrics AND a TraceSpool spilling the
+    ring to disk, both at production cadence (0.25 s, tighter than the
+    collector's 0.5 s default) — on a closed-loop serving workload, vs the
+    SAME traced workload with neither running. Telemetry stays ENABLED in
+    both modes: this row isolates the collector+spool tax, not the (base
+    serving variant's) tracing tax. Collector and spool run in-process
+    with the replica here deliberately — the worst case, where their
+    pulls and fsyncs contend with serving for the same cores. Paired
+    best-of ratio, same burst-cancellation reason as the other
+    variants."""
+    import http.client as _http
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.serving import InferenceEngine, ServingHTTPServer
+    from deeplearning4j_tpu.serving.fleet import FleetCollector, FleetRouter
+    from deeplearning4j_tpu.telemetry import MetricsRegistry
+    from deeplearning4j_tpu.telemetry.spool import TraceSpool
+    rng = np.random.default_rng(29)
+    payloads = [json.dumps({"features": rng.normal(size=(n, 32)).tolist()})
+                .encode() for n in (1, 3, 8, 2)]
+    # fresh registry for the measurement: a replica only ever spools and
+    # serves ITS OWN ring — the process-wide ring may hold tens of
+    # thousands of unrelated events (the tier-1 suite's), and spilling /
+    # pulling those would charge this variant for history it never made
+    reg = MetricsRegistry(enabled=True)
+    prev_reg = telemetry.set_registry(reg)
+    eng = InferenceEngine(net, feature_shape=(32,), buckets=(4, 8),
+                          batch_window_ms=0.2)
+    srv = ServingHTTPServer(engine=eng)
+    port = srv.start()
+    per_client = max(1, n_requests // clients)
+    times = {True: [], False: []}
+    router = FleetRouter(policy="round_robin", health_period_s=3600.0)
+    router.add_url(f"http://127.0.0.1:{port}", "b0")
+    spool_dir = _tempfile.mkdtemp(prefix="bench_spool_")
+    try:
+        def client(ci):
+            conn = _http.HTTPConnection("127.0.0.1", port, timeout=30)
+            for i in range(per_client):
+                conn.request("POST", "/predict",
+                             payloads[(ci + i) % len(payloads)],
+                             {"Content-Type": "application/json",
+                              "X-Trace-Id": f"{ci + 1:032x}"})
+                r = conn.getresponse()
+                r.read()
+            conn.close()
+
+        def loop(collected):
+            collector = spool = None
+            if collected:
+                collector = FleetCollector(router, period_s=0.25).start()
+                spool = TraceSpool(
+                    os.path.join(spool_dir, "replica-b0.spool.json"),
+                    replica_id="b0", period_s=0.25).start()
+            try:
+                threads = [_threading.Thread(target=client, args=(ci,))
+                           for ci in range(clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            finally:
+                if collector is not None:
+                    collector.stop()
+                if spool is not None:
+                    spool.stop()
+
+        for mode in (True, False):
+            loop(mode)               # warm + settle
+        for _ in range(repeats):
+            for mode in (True, False):
+                t0 = time.perf_counter()
+                loop(mode)
+                times[mode].append(time.perf_counter() - t0)
+    finally:
+        telemetry.set_registry(prev_reg)
+        srv.stop()
+        router.client.close()
+    total = per_client * clients
+    ratios = [t / b for t, b in zip(times[True], times[False])]
+    return {"fleet_collector_overhead_pct":
+            round((float(np.min(ratios)) - 1.0) * 100.0, 2),
+            "fleet_collected_req_per_sec":
+            round(total / float(np.min(times[True])), 1),
+            "fleet_uncollected_req_per_sec":
             round(total / float(np.min(times[False])), 1)}
 
 

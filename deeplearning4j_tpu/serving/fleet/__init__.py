@@ -17,6 +17,10 @@ horizontal decode throughput, and elasticity:
                   mark-dead discipline, drain-then-stop scale-in
   - autoscale.py  pure decide() on SLO burn rate + queue depth, one-step
                   moves under cooldowns; actuator thread
+  - collector.py  supervisor-side observability: incremental trace-ring
+                  pulls with replica attribution, cross-process timeline
+                  stitching, dead-replica spool recovery, merged-bucket
+                  fleet metrics + fleet-level SLO watchdog
   - coldstart.py  load-not-compile cold start via the persistent
                   compilation cache (DL4J_TPU_COMPILE_CACHE)
   - http.py       the front door: single-replica wire protocol, fleet
@@ -27,6 +31,7 @@ from .affinity import AffinityMap, AffinityPolicy, prompt_chain, \
 from .autoscale import Autoscaler, AutoscalePolicy, decide
 from .coldstart import (configure_compile_cache, configured_cache_dir,
                         fresh_compile_count)
+from .collector import AggregateRegistry, FleetCollector, merge_raw_metrics
 from .http import FleetHTTPServer
 from .replica import ReplicaProcess
 from .router import (DEAD_AFTER, FleetError, FleetHTTPError, FleetRouter,
@@ -35,6 +40,7 @@ from .router import (DEAD_AFTER, FleetError, FleetHTTPError, FleetRouter,
 __all__ = [
     "AffinityMap", "AffinityPolicy", "prompt_chain", "rendezvous_order",
     "Autoscaler", "AutoscalePolicy", "decide",
+    "AggregateRegistry", "FleetCollector", "merge_raw_metrics",
     "configure_compile_cache", "configured_cache_dir",
     "fresh_compile_count",
     "FleetHTTPServer", "ReplicaProcess",

@@ -75,7 +75,10 @@ class Autoscaler:
     router's health poller flips it READY when its ready file + /health
     land). ``watchdog`` is a telemetry/slo.py ``SLOWatchdog`` (or any
     object with ``check() -> {"breached": [...]}``); None means
-    queue-depth-only scaling."""
+    queue-depth-only scaling. For FLEET-level objectives — burn rate over
+    every replica's latency buckets merged honestly, not one process's
+    view — pass ``FleetCollector.make_watchdog(objectives)`` (see
+    collector.py): same check() contract, fleet-wide data."""
 
     def __init__(self, router, spec_factory: Callable[[int], object], *,
                  policy: Optional[AutoscalePolicy] = None,

@@ -14,10 +14,25 @@ losses close the stream with ``reason: "replica_lost"``.
                            per-replica states + fleet counters
   GET  /metrics            router metrics (+ per-replica /metrics scrape
                            with {"scrape": false} absent — the
-                           fleet_report tool folds these)
+                           fleet_report tool folds these; with a
+                           collector attached also "slo" + "collector")
+  GET  /metrics/prometheus fleet Prometheus text: front-door samples,
+                           per-replica samples with replica="<id>"
+                           labels, and fleet_* aggregates whose
+                           histogram buckets are merged cumulative
+                           counts (honest fleet p99)
+  GET  /debug/trace        distinct stitched trace ids the collector
+                           currently holds
+  GET  /debug/trace/<id>   one request's cross-process timeline —
+                           front-door ingress + fleet routing + replica
+                           decode spans merged chronologically
   GET  /fleet              membership table (states, steering, restarts)
   POST /scale              {"op": "drain"|"kill", "replica": id} — ops
                            scale-in and chaos injection share the door
+
+Trace/aggregation routes need a :class:`~.collector.FleetCollector`
+(``FleetHTTPServer(router, collector=...)``); without one they answer
+503 so a collector-less fleet still serves everything else.
 """
 from __future__ import annotations
 
@@ -34,8 +49,9 @@ from .router import FleetHTTPError, FleetRouter, NoReadyReplicaError
 
 class FleetHTTPServer:
     def __init__(self, router: FleetRouter, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", collector=None):
         self.router = router
+        self.collector = collector      # FleetCollector or None
         self.host = host
         self._port = port
         self._httpd = None
@@ -50,6 +66,7 @@ class FleetHTTPServer:
 
         from ...util.httpjson import read_json, write_json
         router = self.router
+        collector = self.collector
 
         class Handler(hs.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -96,9 +113,20 @@ class FleetHTTPServer:
                             "states": {r["id"]: r["state"] for r in rows},
                             "policy": router.policy}
                     write_json(self, 200 if ready else 503, body)
+                # collector-backed routes dispatch BEFORE the
+                # startswith("/metrics") catch-all below
+                elif self.path == "/metrics/prometheus":
+                    self._prometheus()
+                elif self.path == "/debug/trace" or \
+                        self.path.startswith("/debug/trace/"):
+                    self._stitched_trace()
                 elif self.path.startswith("/metrics"):
                     body = router.metrics()
                     body["replica_metrics"] = self._scrape()
+                    if collector is not None:
+                        body["collector"] = collector.snapshot()
+                        if collector.watchdog is not None:
+                            body["slo"] = collector.watchdog.check()
                     write_json(self, 200, body)
                 elif self.path == "/fleet":
                     write_json(self, 200, {"replicas": router.replicas(),
@@ -107,6 +135,48 @@ class FleetHTTPServer:
                 else:
                     write_json(self, 404,
                                {"error": f"no route {self.path}"})
+
+            def _prometheus(self):
+                """Fleet Prometheus text dump. A bucket-ladder mismatch
+                is refused loudly (500 naming the offending histogram)
+                rather than silently mis-merged — the merge-correctness
+                contract the regression tests pin."""
+                from ...telemetry.registry import HistogramLadderMismatch
+                try:
+                    if collector is not None:
+                        text = collector.to_prometheus_text()
+                    else:
+                        text = get_registry().to_prometheus_text()
+                except HistogramLadderMismatch as e:
+                    write_json(self, 500, {
+                        "error": str(e), "kind": "HistogramLadderMismatch"})
+                    return
+                data = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _stitched_trace(self):
+                if collector is None:
+                    write_json(self, 503, {
+                        "error": "no FleetCollector attached to this "
+                                 "front door"})
+                    return
+                collector.pull_once()   # serve fresh, not period-stale
+                if self.path == "/debug/trace":
+                    write_json(self, 200,
+                               {"traces": collector.trace_ids()})
+                    return
+                tid = self.path[len("/debug/trace/"):]
+                events = collector.events_for_trace(tid)
+                if not events:
+                    write_json(self, 404,
+                               {"error": f"no events for trace {tid!r}"})
+                    return
+                write_json(self, 200, {"trace_id": tid, "events": events})
 
             def _scrape(self) -> dict:
                 """Per-replica /metrics snapshots (best effort — a dead
@@ -274,4 +344,6 @@ class FleetHTTPServer:
             self._httpd.server_close()
             self._httpd = None
         if close_router:
+            if self.collector is not None:
+                self.collector.stop()
             self.router.close()

@@ -78,6 +78,11 @@ class ReplicaProcess:
         self.ready_path = os.path.join(workdir,
                                        f"replica-{self.id}.ready.json")
         self.log_path = os.path.join(workdir, f"replica-{self.id}.log")
+        # crash-durable black box: the child periodically spills its trace
+        # ring + raw metrics here (telemetry/spool.py); survives SIGKILL
+        self.spool_path = os.path.join(workdir,
+                                       f"replica-{self.id}.spool.json")
+        self.spec.setdefault("spool_path", self.spool_path)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ReplicaProcess":
@@ -275,6 +280,14 @@ def _child_main(argv=None) -> int:
         json.dump(ready, f)
     os.replace(tmp, args.ready_file)    # atomic: never a half-read ready
 
+    spool = None
+    if spec.get("spool_path"):
+        from ...telemetry.spool import TraceSpool
+        spool = TraceSpool(spec["spool_path"],
+                           replica_id=str(spec.get("replica_id") or ""),
+                           period_s=float(spec.get("spool_period_s", 0.25))
+                           ).start()
+
     import threading
     stop = threading.Event()
 
@@ -292,6 +305,8 @@ def _child_main(argv=None) -> int:
         if os.getppid() != parent:
             break
     srv.stop(drain=True)                # finish in-flight, 503 the rest
+    if spool is not None:
+        spool.stop()                    # final spill covers the drain tail
     return 0
 
 

@@ -91,6 +91,7 @@ class _Replica:
                 "pid": self.proc.pid if self.proc else None,
                 "consecutive_failures": self.fails,
                 "restarts": self.restarts, "forwarded": self.forwarded,
+                "spool_path": getattr(self.proc, "spool_path", None),
                 "steering": self.steering}
 
 
@@ -248,11 +249,14 @@ class FleetRouter:
         if reg.enabled:
             reg.counter("fleet.replica_deaths").inc()
         event("fleet.replica_dead", replica=r.id, reason=reason)
-        # black box: what was the fleet doing when it lost this replica
+        # black box: what was the fleet doing when it lost this replica —
+        # plus what the VICTIM was doing, recovered from its crash-durable
+        # spool spill (telemetry/spool.py). A SIGKILLed replica cannot dump
+        # anything itself; its last periodic spill speaks for it.
         get_flight_recorder().dump(
             "fleet_replica_lost", replica=r.id, reason=reason,
             consecutive_failures=r.fails, affinity_entries_dropped=dropped,
-            restarts=r.restarts)
+            restarts=r.restarts, victim_spill=self._victim_spill(r))
         # state flips LAST: an observer that polls to "dead" may rely on
         # the black box already being on disk (the chaos tests do)
         r.state = DEAD
@@ -261,6 +265,23 @@ class FleetRouter:
             r._restarting = True
             threading.Thread(target=self._restart, args=(r,),
                              daemon=True, name=f"fleet-restart-{r.id}").start()
+
+    @staticmethod
+    def _victim_spill(r: _Replica, cap: int = 512) -> Optional[dict]:
+        """The dead replica's last spool spill, event tail capped so the
+        dump stays readable; None when no black box survived."""
+        path = getattr(r.proc, "spool_path", None)
+        if not path:
+            return None
+        from ...telemetry.spool import read_spool
+        spill = read_spool(path)
+        if spill is None:
+            return None
+        events = spill.get("events") or []
+        if len(events) > cap:
+            spill = {**spill, "events": events[-cap:],
+                     "events_truncated": len(events) - cap}
+        return spill
 
     def _restart(self, r: _Replica) -> None:
         try:

@@ -23,6 +23,13 @@ to 400):
   GET  /metrics            per-model serving metrics (+ "generation" key
                            when a generation engine is attached)
   GET  /models             registry listing (version, buckets, warm state)
+  GET  /debug/trace        the registry's trace ring as NDJSON, one event
+                           per line with its ``seq`` stamp;
+                           ``?since_seq=N`` returns only events past the
+                           cursor (the fleet collector's incremental pull)
+  GET  /debug/metrics      raw mergeable metrics: counters, gauges, and
+                           histograms as cumulative ``le`` buckets —
+                           the fleet-aggregation wire format
   POST /reload             {"model": name, "path": zip-or-checkpoint-dir}
                            -> zero-downtime hot-swap (forward-serving OR
                            generation model), returns new version
@@ -162,6 +169,18 @@ class ServingHTTPServer:
                     self._trace_ctx = None
 
             def _route_get(self):
+                # query strings only exist on the /debug/trace cursor
+                # route; every exact-match route below keeps seeing the
+                # bare path
+                path, _, query = self.path.partition("?")
+                if path == "/debug/trace":
+                    self._debug_trace(query)
+                    return
+                if path == "/debug/metrics":
+                    # mergeable raw metrics (cumulative le buckets, not
+                    # percentiles) — what the fleet collector aggregates
+                    write_json(self, 200, get_registry().raw_metrics())
+                    return
                 if self.path == "/health":
                     depths = engine.queue_depths() if engine else {}
                     gdepths = generation.queue_depths() if generation else {}
@@ -250,6 +269,34 @@ class ServingHTTPServer:
                 else:
                     self._drain_body()
                     write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def _debug_trace(self, query: str):
+                """Incremental trace-ring export: NDJSON, one Chrome-trace
+                event per line, each carrying its registry ``seq`` stamp.
+                ``?since_seq=N`` returns only events past the cursor —
+                the fleet collector pulls deltas, never the full ring.
+                ``X-Trace-Seq`` echoes the registry watermark so an empty
+                body still advances the caller's cursor."""
+                from urllib.parse import parse_qs
+                reg = get_registry()
+                try:
+                    q = parse_qs(query)
+                    since = int(q.get("since_seq", ["0"])[0])
+                except (ValueError, TypeError):
+                    write_json(self, 400,
+                               {"error": "since_seq must be an integer"})
+                    return
+                events = reg.trace_events_since(since)
+                body = "".join(json.dumps(e) + "\n"
+                               for e in events).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Trace-Seq", str(reg.last_seq))
+                self.send_header("X-Trace-Dropped",
+                                 str(reg.trace_dropped))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _memprof(self):
                 """Live memory profile (telemetry/memprof.py): top-K

@@ -29,13 +29,15 @@ from .perf import (PerfBaseline, ProgramCostIndex, StepAccounting,
                    classify_roofline, get_cost_index, implied_mfu,
                    normalize_cost_analysis, perf_snapshot, set_cost_index,
                    write_perf_dump)
-from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       get_registry, set_registry)
+from .registry import (Counter, Gauge, Histogram, HistogramLadderMismatch,
+                       MetricsRegistry, bucket_quantile, get_registry,
+                       merge_cumulative_buckets, set_registry)
 from .slo import (ErrorRateSLO, LatencySLO, SLOWatchdog, ThroughputSLO,
                   TrainingWatch, get_slo_watchdog, get_training_watch,
                   set_slo_watchdog, set_training_watch)
 from .spans import (Span, current_span, current_span_path,
                     record_external_span, span)
+from .spool import TraceSpool, read_spool
 from .tracecontext import (TraceContext, adopt, current_trace_context,
                            current_trace_id, event, handoff,
                            new_trace_context, normalize_trace_id,
@@ -43,7 +45,10 @@ from .tracecontext import (TraceContext, adopt, current_trace_context,
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "HistogramLadderMismatch", "bucket_quantile",
+    "merge_cumulative_buckets",
     "get_registry", "set_registry",
+    "TraceSpool", "read_spool",
     "Span", "span", "current_span", "current_span_path",
     "record_external_span",
     "TraceContext", "new_trace_context", "normalize_trace_id",

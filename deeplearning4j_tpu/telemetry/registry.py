@@ -22,6 +22,7 @@ Design constraints (the hot paths this instruments are dispatch-bound):
 """
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -30,7 +31,8 @@ from collections import deque
 from typing import Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "set_registry"]
+           "HistogramLadderMismatch", "bucket_quantile",
+           "merge_cumulative_buckets", "get_registry", "set_registry"]
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -49,11 +51,65 @@ DEFAULT_BUCKET_BOUNDS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
                          30000.0, 60000.0)
 
 
+class HistogramLadderMismatch(ValueError):
+    """Two histograms with different ``le`` bucket ladders cannot be
+    merged: summing misaligned cumulative buckets would silently produce
+    a wrong fleet p99. The fleet collector refuses loudly instead —
+    every replica must observe on the one canonical ladder
+    (:data:`DEFAULT_BUCKET_BOUNDS`) or declare its own fleet-wide."""
+
+
+def merge_cumulative_buckets(bounds, cumulative_lists) -> List[int]:
+    """Elementwise sum of cumulative ``le`` bucket counts from N
+    histograms that all share ``bounds`` (each list is ``len(bounds)+1``
+    long, last entry == +Inf == lifetime count). Mismatched lengths
+    raise :class:`HistogramLadderMismatch` — merge math is only honest
+    on one ladder."""
+    want = len(bounds) + 1
+    out = [0] * want
+    for cum in cumulative_lists:
+        if len(cum) != want:
+            raise HistogramLadderMismatch(
+                f"cumulative bucket list of length {len(cum)} does not "
+                f"fit a {len(bounds)}-bound ladder (want {want})")
+        for i, c in enumerate(cum):
+            out[i] += int(c)
+    return out
+
+
+def bucket_quantile(bounds, cumulative, q: float) -> float:
+    """Quantile estimate from cumulative ``le`` buckets: the smallest
+    bound whose cumulative count covers ``q`` of the total (observations
+    past the last bound report that bound — the ladder's honest ceiling).
+    This is THE fleet p99: computed on merged buckets it equals the
+    single-registry computation on the same observations exactly,
+    because both reduce to the same integer rank lookup."""
+    if not bounds or not cumulative:
+        return 0.0
+    total = cumulative[-1]
+    if total <= 0:
+        return 0.0
+    # nearest-rank on the cumulative counts: rank in [1, total]
+    rank = max(1, min(total, int(round(q * (total - 1))) + 1))
+    for bound, cnt in zip(bounds, cumulative):
+        if cnt >= rank:
+            return float(bound)
+    return float(bounds[-1])
+
+
 def escape_label_value(v) -> str:
     """Prometheus exposition-format label-value escaping: backslash,
     double-quote and newline must be escaped inside the quotes."""
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Dots/dashes -> underscores: one sanitizer for every exposition
+    surface (the registry's own dump AND the fleet collector's merged
+    dump must agree on names or dashboards see two series)."""
+    return "".join(ch if (ch.isalnum() or ch == "_") else "_"
+                   for ch in name)
 
 
 class Counter:
@@ -174,6 +230,19 @@ class Histogram:
         with self._lock:
             return sum(self._bucket_counts[:idx + 1]), self._count
 
+    def raw(self) -> dict:
+        """Wire-format export for cross-process aggregation (the fleet
+        collector's ``/debug/metrics`` pull): bounds + cumulative ``le``
+        buckets + lifetime count/sum, all under ONE lock so the merge
+        math never sees a torn (buckets, count) pair."""
+        with self._lock:
+            cum, acc = [], 0
+            for c in self._bucket_counts:
+                acc += c
+                cum.append(acc)
+            return {"bounds": list(self._bounds), "cumulative": cum,
+                    "count": self._count, "sum": self._sum}
+
     def percentiles(self) -> Dict[str, float]:
         with self._lock:
             vals = sorted(self._ring)
@@ -266,6 +335,12 @@ class MetricsRegistry:
         self.trace_capacity = trace_capacity
         self._trace: deque = deque(maxlen=trace_capacity)
         self._trace_dropped = 0
+        # monotonic per-event sequence stamp: itertools.count().__next__
+        # is GIL-atomic, so the recording path stays lock-free while
+        # incremental consumers (the fleet collector's since_seq cursor,
+        # the crash spool) get an exactly-once watermark
+        self._trace_seq = itertools.count(1)
+        self._last_seq = 0
 
     # ------------------------------------------------------------- accessors
     def counter(self, name: str) -> Counter:
@@ -319,10 +394,29 @@ class MetricsRegistry:
         hooks call this; callers check ``enabled`` first)."""
         if len(self._trace) == self._trace.maxlen:
             self._trace_dropped += 1
+        seq = next(self._trace_seq)
+        event["seq"] = seq          # extra key; Chrome trace ignores it
+        self._last_seq = seq
         self._trace.append(event)
 
     def trace_events(self) -> List[dict]:
         return list(self._trace)
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence stamp of the most recently recorded event (0 before
+        the first) — the cursor an incremental reader resumes from."""
+        return self._last_seq
+
+    def trace_events_since(self, seq: int) -> List[dict]:
+        """Events with ``seq`` strictly greater than the cursor — the
+        incremental pull the replica's ``GET /debug/trace?since_seq=``
+        route serves. A cursor older than the ring's tail simply returns
+        the whole ring (the evicted gap is visible as non-contiguous seq
+        numbers plus ``trace_dropped``; no silent pretense of
+        completeness)."""
+        seq = int(seq)
+        return [e for e in self._trace if e.get("seq", 0) > seq]
 
     @property
     def trace_dropped(self) -> int:
@@ -378,6 +472,21 @@ class MetricsRegistry:
                 "spans_recorded": len(self._trace),
                 "spans_dropped": self._trace_dropped}
 
+    def raw_metrics(self) -> dict:
+        """Mergeable export: counter values, gauge value/max, histograms
+        in :meth:`Histogram.raw` wire format (bounds + cumulative ``le``
+        buckets + count/sum). This is what ``GET /debug/metrics`` serves
+        and what the fleet collector sums — unlike :meth:`snapshot` it
+        carries the raw buckets, so fleet percentiles are computed from
+        merged counts instead of averaging per-replica percentiles."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: {"value": g.value, "max": g.max}
+                      for n, g in self._gauges.items()}
+            hists = list(self._histograms.items())
+        return {"counters": counters, "gauges": gauges,
+                "histograms": {n: h.raw() for n, h in hists}}
+
     def to_prometheus_text(self, prefix: str = "dl4j_tpu", *,
                            compat_quantiles: bool = False) -> str:
         """Prometheus text exposition format. Metric names are sanitized
@@ -388,10 +497,7 @@ class MetricsRegistry:
         restores the pre-ISSUE-13 summary-style dump (ad-hoc
         ``quantile=`` gauges from the bounded ring) for scrapers that
         grew to depend on those keys."""
-        def san(name: str) -> str:
-            return "".join(ch if (ch.isalnum() or ch == "_") else "_"
-                           for ch in name)
-
+        san = sanitize_metric_name
         lines: List[str] = []
         with self._lock:
             counters = list(self._counters.items())

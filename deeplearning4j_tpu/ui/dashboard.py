@@ -42,6 +42,7 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>{t_ratio}</h2>{ratio_chart}</div>
 {performance_card}
 {telemetry_card}
+{fleet_card}
 {hist_cards}
 {activation_cards}
 {graph_card}
@@ -152,6 +153,43 @@ def _render_telemetry_card(title: str) -> str:
         "<th>count</th></tr>" + hrows + "</table>") if hrows else ""
     return (f"<div class='card'><h2>{title}</h2>"
             f"<table>{rows}</table>{hist_table}</div>")
+
+
+def _render_fleet_card(title: str) -> str:
+    """Fleet card from the gauges the FleetCollector publishes into the
+    local registry (``fleet.replica.<rid>.*`` — per-replica prefix-cache
+    hit rate, queue depth, decode-slot occupancy) plus the fleet SLO
+    burn-rate gauges the collector-made watchdog writes (``slo.<name>.
+    burn_rate.*``). No collector running (no such gauges) renders
+    nothing — a single-process dashboard keeps its old page."""
+    from ..telemetry import get_registry
+    reg = get_registry()
+    if not reg.enabled:
+        return ""
+    prefix = "fleet.replica."
+    per: dict = {}
+    for name, g in reg.gauges_matching(prefix):
+        rest = name[len(prefix):]
+        rid, _, metric = rest.partition(".")
+        if rid and metric:
+            per.setdefault(rid, {})[metric] = g.value
+    if not per:
+        return ""
+    rows = "".join(
+        f"<tr><td>{html.escape(rid)}</td>"
+        f"<td>{round(m_.get('prefix_hit_rate', 0.0), 4)}</td>"
+        f"<td>{round(m_.get('queue_depth', 0.0), 1)}</td>"
+        f"<td>{round(m_.get('slot_occupancy', 0.0), 4)}</td></tr>"
+        for rid, m_ in sorted(per.items()))
+    table = ("<table><tr><th>replica</th><th>prefix hit</th>"
+             "<th>queue</th><th>occupancy</th></tr>" + rows + "</table>")
+    burn_rows = "".join(
+        f"<tr><th>{html.escape(name[len('slo.'):])}</th>"
+        f"<td>{round(g.value, 3)}</td></tr>"
+        for name, g in sorted(reg.gauges_matching("slo.")
+                              ) if ".burn_rate." in name)
+    burn_table = (f"<table>{burn_rows}</table>" if burn_rows else "")
+    return (f"<div class='card'><h2>{title}</h2>{table}{burn_table}</div>")
 
 
 def _render_kernels_table(reg, snap, heading: str) -> str:
@@ -420,6 +458,7 @@ def render_dashboard_html(storage: StatsStorage, session_id: Optional[str] = Non
         performance_card=_render_performance_card(
             m("train.performance"), kernels_heading=m("train.kernels")),
         telemetry_card=_render_telemetry_card(m("train.telemetry")),
+        fleet_card=_render_fleet_card(m("train.fleet")),
         hist_cards=hist_cards,
         activation_cards=activation_cards,
         graph_card=graph_card,

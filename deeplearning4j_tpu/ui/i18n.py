@@ -33,6 +33,7 @@ _EN = {
     "train.telemetry": "Runtime telemetry",
     "train.performance": "Performance (MFU / roofline / memory)",
     "train.kernels": "Kernels (impl / blocks / roofline)",
+    "train.fleet": "Serving fleet (replicas / SLO burn)",
 }
 
 _MESSAGES: Dict[str, Dict[str, str]] = {
@@ -55,6 +56,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.telemetry": "Laufzeit-Telemetrie",
         "train.performance": "Leistung (MFU / Roofline / Speicher)",
         "train.kernels": "Kernel (Implementierung / Blöcke / Roofline)",
+        "train.fleet": "Serving-Flotte (Replikate / SLO-Burn)",
     },
     "ja": {
         "train.pagetitle": "トレーニング概要",
@@ -74,6 +76,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.telemetry": "ランタイムテレメトリ",
         "train.performance": "パフォーマンス（MFU / ルーフライン / メモリ）",
         "train.kernels": "カーネル（実装 / ブロック / ルーフライン）",
+        "train.fleet": "サービングフリート（レプリカ / SLOバーン）",
     },
     "ko": {
         "train.pagetitle": "훈련 개요",
@@ -93,6 +96,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.telemetry": "런타임 텔레메트리",
         "train.performance": "성능 (MFU / 루프라인 / 메모리)",
         "train.kernels": "커널 (구현 / 블록 / 루프라인)",
+        "train.fleet": "서빙 플릿 (레플리카 / SLO 번)",
     },
     "ru": {
         "train.pagetitle": "Обзор обучения",
@@ -112,6 +116,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.telemetry": "Телеметрия выполнения",
         "train.performance": "Производительность (MFU / roofline / память)",
         "train.kernels": "Ядра (реализация / блоки / roofline)",
+        "train.fleet": "Флот обслуживания (реплики / расход SLO)",
     },
     "zh": {
         "train.pagetitle": "训练概览",
@@ -131,6 +136,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.telemetry": "运行时遥测",
         "train.performance": "性能（MFU / 屋顶线 / 内存）",
         "train.kernels": "内核（实现 / 块 / 屋顶线）",
+        "train.fleet": "服务集群（副本 / SLO 消耗）",
     },
 }
 

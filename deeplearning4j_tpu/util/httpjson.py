@@ -179,6 +179,22 @@ class HTTPClient:
         except ValueError:
             return status, data.decode("utf-8", "replace")
 
+    def request_ndjson(self, method: str, url: str, *,
+                       headers: Optional[Dict[str, str]] = None,
+                       timeout: Optional[float] = None
+                       ) -> Tuple[int, Dict[str, str], list]:
+        """Full-body NDJSON pull: one JSON value per non-blank line.
+        Returns (status, headers, parsed list) — the fleet collector's
+        ``/debug/trace`` delta pulls ride this. A non-2xx body is
+        returned unparsed as an empty list (status tells the story)."""
+        status, hdrs, data = self.request(method, url, headers=headers,
+                                          timeout=timeout)
+        if not (200 <= status < 300):
+            return status, hdrs, []
+        out = [json.loads(line) for line in data.decode().splitlines()
+               if line.strip()]
+        return status, hdrs, out
+
     @contextmanager
     def stream(self, method: str, url: str, *,
                body: Optional[bytes] = None,

@@ -31,10 +31,12 @@ from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.models.decode import (TransformerDecodeSpec,
                                               naive_generate)
 from deeplearning4j_tpu.models.zoo_extra import transformer_lm
-from deeplearning4j_tpu.serving.fleet import (FleetHTTPServer, FleetRouter,
-                                              ReplicaProcess)
+from deeplearning4j_tpu.serving.fleet import (FleetCollector, FleetHTTPServer,
+                                              FleetRouter, ReplicaProcess)
+from deeplearning4j_tpu.serving.fleet.collector import FRONT_DOOR
 from deeplearning4j_tpu.telemetry import MetricsRegistry
 from deeplearning4j_tpu.telemetry.flightrec import get_flight_recorder
+from deeplearning4j_tpu.telemetry.spool import read_spool
 from deeplearning4j_tpu.util.httpjson import HTTPClient
 
 # big enough that a 200-token decode takes tens of ms on CPU — the chaos
@@ -240,6 +242,107 @@ def test_pre_first_token_kill_replays_idempotently(fleet):
         telemetry.set_registry(prev)
         fleet.router.start()
         _revive(fleet, victim)
+
+
+# ---------------------------------------------------------- observability
+def test_cross_process_trace_stitching(fleet, tmp_path):
+    """ISSUE 19 acceptance: ONE X-Trace-Id through front door -> router ->
+    replica subprocess comes back as a single ts-ordered timeline with
+    per-process replica attribution — front-door spans from the local
+    ring, replica spans pulled over /debug/trace — and trace2timeline
+    renders the same stitched view."""
+    from tools.trace2timeline import format_timeline, load_merged, timeline
+    tid = "feedface2026"
+    reg = MetricsRegistry(enabled=True)
+    prev = telemetry.set_registry(reg)
+    col = FleetCollector(fleet.router, registry=reg)
+    try:
+        status, body = fleet.client.request_json(
+            "POST", fleet.base + "/generate",
+            payload={"prompt": [2, 4, 6, 8], "max_tokens": 3,
+                     "stream": False},
+            headers={"X-Trace-Id": tid}, timeout=120.0)
+        assert status == 200
+        rid = body["replica"]
+        assert col.pull_once() > 0 and col.pull_errors == 0
+        events = col.events_for_trace(tid)
+        replicas = {e["args"]["replica"] for e in events}
+        assert {FRONT_DOOR, rid} <= replicas    # both processes present
+        names = [e["name"] for e in events]
+        assert any(n.startswith("fleet.") for n in names)       # front
+        assert any(n.startswith("generation.") for n in names)  # replica
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)         # epoch-anchored cross-process order
+        # receipt at the front door precedes the replica's work (epoch-
+        # anchored ts makes cross-process ordering meaningful; fleet.route
+        # is recorded when the forward RESOLVES, so it lands later)
+        assert names.index("fleet.request") \
+            < min(i for i, n in enumerate(names)
+                  if n.startswith("generation."))
+        # same timeline through the offline tool
+        f = tmp_path / "stitched.json"
+        f.write_text(json.dumps({"events": events}))
+        rows = timeline(load_merged([str(f)]), tid)
+        assert [r["name"] for r in rows] == names
+        text = format_timeline(rows)
+        assert "replica" in text.splitlines()[0] and rid in text
+    finally:
+        col.stop()
+        telemetry.set_registry(prev)
+
+
+def test_sigkill_black_box_recovered_from_spool(fleet):
+    """ISSUE 19 acceptance, crash-durability half: SIGKILL a replica and
+    its last periodic spool spill still tells the story — readable from
+    disk, embedded as ``victim_spill`` in the fleet_replica_lost dump,
+    and ingested by the collector so the victim's spans stitch into the
+    fleet timeline after death."""
+    tid = "cafebabe2026"
+    reg = MetricsRegistry(enabled=True)
+    prev = telemetry.set_registry(reg)
+    col = FleetCollector(fleet.router, registry=reg)
+    victim = None
+    try:
+        status, body = fleet.client.request_json(
+            "POST", fleet.base + "/generate",
+            payload={"prompt": [4, 8, 12, 16], "max_tokens": 3,
+                     "stream": False},
+            headers={"X-Trace-Id": tid}, timeout=120.0)
+        assert status == 200
+        victim = body["replica"]
+        time.sleep(0.8)                 # > 2 spool periods: spill lands
+        fleet.router.kill_replica(victim)
+        assert _wait_state(fleet.router, victim, "dead", timeout=10.0)
+        # the black box on disk outlived the process
+        spill = read_spool(fleet.procs[victim].spool_path)
+        assert spill is not None and spill["replica"] == victim
+        assert spill["pid"] > 0 and spill["seq"] > 0
+        assert any(e.get("args", {}).get("trace_id") == tid
+                   for e in spill["events"])
+        # ...and is embedded in the fleet_replica_lost dump
+        dump_dir = get_flight_recorder().directory
+        embedded = []
+        for fn in os.listdir(dump_dir):
+            if "fleet_replica_lost" not in fn:
+                continue
+            info = json.load(open(os.path.join(dump_dir, fn)))["info"]
+            if info.get("replica") == victim and info.get("victim_spill"):
+                embedded.append(info["victim_spill"])
+        assert any(any(e.get("args", {}).get("trace_id") == tid
+                       for e in s.get("events", []))
+                   for s in embedded), "no dump embeds the victim's spill"
+        # the collector recovers the victim's spans from the spool
+        col.pull_once()
+        assert col.spools_recovered >= 1
+        events = col.events_for_trace(tid)
+        assert any(e["args"]["replica"] == victim
+                   and e["name"].startswith("generation.")
+                   for e in events)
+    finally:
+        col.stop()
+        telemetry.set_registry(prev)
+        if victim is not None:
+            _revive(fleet, victim)
 
 
 @pytest.mark.slow

@@ -127,10 +127,14 @@ def test_merge_cnn_branch_with_ff_branch():
     assert check_gradients(net, [x_img, x_feat], y, print_results=True)
 
 
+@pytest.mark.slow
 def test_elementwise_add_over_parallel_rnn_branches_timeseries_out():
     """Two LSTM branches element-wise added, RnnOutputLayer time-series
     loss — recurrent CG with a vertex combine (reference
-    ComputationGraphTestRNN element-wise cases)."""
+    ComputationGraphTestRNN element-wise cases). Slow lane (ISSUE 19
+    tier-1 budget reclaim): ElementWiseVertex gradients stay tier-1 in
+    test_computation_graph.py and RNN-head CG gradients in
+    test_two_outputs_ff_and_rnn_heads below."""
     T, V = 3, 3
     g = (_builder()
          .add_inputs("seq")

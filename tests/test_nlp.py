@@ -237,9 +237,14 @@ def test_hs_scatter_update_matches_dense_autodiff():
     np.testing.assert_allclose(np.asarray(got1), np.asarray(want1), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_word2vec_hierarchical_softmax_similarity_structure():
     """Similarity parity with HS enabled (reference useHierarchicSoftmax;
-    VERDICT r2 missing #3)."""
+    VERDICT r2 missing #3). Slow lane (ISSUE 19 tier-1 budget reclaim):
+    ~9s duplicate of the similarity-structure contract —
+    test_word2vec_similarity_structure (negative sampling) keeps it
+    tier-1 and test_word2vec_hs_cbow_trains keeps the HS training
+    path."""
     # HS shares the root path across every word, so without frequent-word
     # subsampling the filler words ('the','a',...) drag all vectors onto one
     # direction on this tiny corpus — sample>0 is the canonical word2vec-HS

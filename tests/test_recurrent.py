@@ -34,7 +34,14 @@ def _seq_data(n=4, t=8, f=3, c=2, seed=1):
     return x, y_seq, y_last
 
 
-@pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM])
+# GravesLSTM alone rides the slow lane (ISSUE 19 tier-1 budget reclaim,
+# ~8s): the Graves cell math is still gradient-checked tier-1 through the
+# GravesBidirectionalLSTM variant, which wraps the same cell.
+@pytest.mark.parametrize("layer_cls", [
+    LSTM,
+    pytest.param(GravesLSTM, marks=pytest.mark.slow),
+    GravesBidirectionalLSTM,
+])
 def test_lstm_gradient_checks(layer_cls):
     net = _rnn_net([layer_cls(n_out=4, activation="tanh"),
                     RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],

@@ -191,10 +191,14 @@ def test_self_draft_accepts_every_proposal():
         eng.stop()
 
 
+@pytest.mark.slow
 def test_speculative_lstm_draft_bit_identical():
     """The state-adapter draft: an LSTM proposes, the stacked-state rewind
     rolls its recurrent state back to exactly what verify accepted —
-    output stays plain-greedy-identical even at near-zero acceptance."""
+    output stays plain-greedy-identical even at near-zero acceptance.
+    Slow lane (ISSUE 19 tier-1 budget reclaim): the transformer-draft
+    bit-identity + acceptance pins in this file keep the speculative
+    greedy-identity contract tier-1."""
     net = _lm(seed=11, vocab=37, d_model=16, n_blocks=1, max_length=32)
     lstm = text_generation_lstm(vocab_size=37, hidden=12, max_length=32,
                                 seed=5).init()

@@ -108,6 +108,78 @@ def test_registry_prometheus_dump(fresh_registry):
     assert "_bucket" not in compat
 
 
+def test_trace_seq_cursoring(fresh_registry):
+    """ISSUE 19: every recorded event carries a monotonic ``seq`` and
+    ``trace_events_since`` returns only the delta — the incremental-pull
+    contract the replica's /debug/trace route and the fleet collector's
+    cursors are built on."""
+    reg = fresh_registry
+    assert reg.last_seq == 0
+    with span("a"):
+        pass
+    with span("b"):
+        pass
+    events = reg.trace_events()
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    cursor = seqs[0]
+    delta = reg.trace_events_since(cursor)
+    assert [e["seq"] for e in delta] == [s for s in seqs if s > cursor]
+    assert reg.trace_events_since(reg.last_seq) == []
+    # a stale (pre-ring) cursor returns the whole ring, never raises
+    assert len(reg.trace_events_since(-1)) == len(events)
+
+
+def test_raw_metrics_round_trips_histogram_buckets(fresh_registry):
+    """raw_metrics() is the mergeable wire format: cumulative buckets on
+    the canonical ladder, counter values, gauge value+max."""
+    reg = fresh_registry
+    reg.counter("c").inc(3)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("lat_ms")
+    for v in (1.0, 4.0, 900.0):
+        h.observe(v)
+    raw = reg.raw_metrics()
+    assert raw["counters"]["c"] == 3
+    assert raw["gauges"]["g"]["value"] == 2.5
+    hr = raw["histograms"]["lat_ms"]
+    assert hr["count"] == 3 and hr["cumulative"][-1] == 3
+    assert hr["bounds"] == list(h.bounds)
+    # cumulative is monotone non-decreasing
+    assert all(a <= b for a, b in zip(hr["cumulative"],
+                                      hr["cumulative"][1:]))
+
+
+def test_trace_spool_round_trip_and_skip(fresh_registry, tmp_path):
+    """The crash-durable black box: flush writes an atomic, parseable
+    spill of ring tail + raw metrics; an unchanged ring skips the disk
+    write; stop() force-flushes the final state."""
+    from deeplearning4j_tpu.telemetry import TraceSpool, read_spool
+    reg = fresh_registry
+    path = str(tmp_path / "replica-r7.spool.json")
+    spool = TraceSpool(path, replica_id="r7", registry=reg, capacity=4)
+    with span("work"):
+        reg.counter("done").inc()
+    assert spool.flush() is True
+    spill = read_spool(path)
+    assert spill["replica"] == "r7" and spill["seq"] == reg.last_seq
+    assert spill["metrics"]["counters"]["done"] == 1
+    assert [e["name"] for e in spill["events"]] == ["work"]
+    # no ring advance -> flush is a no-op (idle replicas cost zero I/O)
+    assert spool.flush() is False and spool.skipped == 1
+    for i in range(8):
+        with span(f"s{i}"):
+            pass
+    assert spool.flush() is True
+    spill = read_spool(path)
+    assert len(spill["events"]) == 4         # capacity bounds the tail
+    assert spill["events"][-1]["name"] == "s7"
+    # absent / garbage files read as None, never raise
+    assert read_spool(str(tmp_path / "nope.json")) is None
+    (tmp_path / "junk.json").write_text("{not json")
+    assert read_spool(str(tmp_path / "junk.json")) is None
+
+
 def test_registry_stats_storage_bridge(fresh_registry):
     from deeplearning4j_tpu.ui import InMemoryStatsStorage
     reg = fresh_registry

@@ -48,8 +48,8 @@ def test_zoo_extra_models_build():
     """Structure checks: init + param counts at small spatial dims. Slow
     lane (ISSUE 14 tier-1 budget reclaim): ~21s of tier-1 whose unique
     coverage is thin — test_googlenet_steps re-checks the googlenet param
-    count (already slow) and test_facenet_l2_embeddings_forward (tier-1)
-    inits facenet end-to-end."""
+    count (already slow) and test_facenet_l2_embeddings_forward (also
+    slow since ISSUE 19) inits facenet end-to-end."""
     # GoogLeNet's param count is input-size independent (global pooling);
     # ~6M at 10 classes vs reference ~7M at 1000 (the fc1 input is 1024)
     assert 4_000_000 < googlenet(n_classes=10, height=48,
@@ -67,7 +67,12 @@ def test_googlenet_steps():
     assert np.allclose(out.sum(-1), 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_facenet_l2_embeddings_forward():
+    # Slow lane (ISSUE 19 tier-1 budget reclaim): ~18s init+forward of the
+    # biggest zoo graph. The facenet leg (build + L2-normalized embeddings
+    # + train steps) now lives entirely in the slow lane alongside
+    # test_facenet_nn4_small2_steps / test_zoo_extra_models_build.
     net = facenet_nn4_small2(n_classes=5, height=48, width=48,
                              embedding_size=32).init()
     # embeddings vertex is L2-normalized
@@ -225,9 +230,15 @@ def test_pretrained_cache_checksum_and_load(tmp_path):
                         cache_dir=cache)
 
 
+@pytest.mark.slow
 def test_text_generation_sampling():
     """Streaming temperature sampling off a trained char model (reference
-    TextGenerationLSTM's use case)."""
+    TextGenerationLSTM's use case). Slow lane (ISSUE 19 tier-1 budget
+    reclaim): ~11s of 120-epoch training to a learnable cycle; the
+    char-LM fit contract stays tier-1 via test_text_generation_lstm_fits
+    and temperature/sampling decode paths are tier-1-exercised by the
+    generation engine's mixed-settings stream
+    (test_generation.py::test_zero_recompiles_generation_after_warmup)."""
     from deeplearning4j_tpu.models.zoo_extra import sample_text
     V = 8
     net = text_generation_lstm(vocab_size=V, max_length=16, hidden=32,

@@ -18,8 +18,12 @@ aligned per-replica table:
     number affinity routing exists to raise)
   - generation latency p50/p99 when the replica scrape carries them
 
-plus a totals row and the router's own counters (requests, retries,
-streams_lost, replica_deaths, rejected). Multiple snapshot files merge
+plus a totals row, the router's own counters (requests, retries,
+streams_lost, replica_deaths, rejected), and — when the front door runs
+a :class:`FleetCollector` — the fleet SLO evaluation (one line per
+objective with per-window burn rates and verdict) and the collector's
+stitching health (pulls, events, recovered spools). Multiple snapshot
+files merge
 by replica id (later files win), so dumps taken before and after an
 incident diff in one invocation.
 
@@ -113,6 +117,8 @@ def fold(snap: dict) -> dict:
     return {"policy": snap.get("policy"),
             "block_len": snap.get("block_len"),
             "affinity": snap.get("affinity"),
+            "slo": snap.get("slo"),
+            "collector": snap.get("collector"),
             "rows": rows, "totals": totals, "counters": counters}
 
 
@@ -161,6 +167,28 @@ def render(report: dict) -> str:
             f"{aff.get('capacity', '?')} entries"
             + ("  (" + ", ".join(f"{k}:{v}" for k, v in sorted(per.items()))
                + ")" if per else ""))
+    # fleet SLOs (present when the front door runs a collector watchdog):
+    # one line per objective — target, per-window burn rates, verdict
+    slo = report.get("slo")
+    if isinstance(slo, dict) and isinstance(slo.get("objectives"), dict):
+        breached = set(slo.get("breached") or [])
+        lines.append("fleet SLOs:")
+        for name, row in sorted(slo["objectives"].items()):
+            burns = "  ".join(
+                f"burn[{w}]={v:.2f}" if isinstance(v, (int, float))
+                else f"burn[{w}]={v}"
+                for w, v in sorted((row.get("burn_rates") or {}).items()))
+            verdict = "BREACHED" if name in breached else "ok"
+            lines.append(f"  {name}: target={row.get('target')}  "
+                         f"{burns}  {verdict}")
+    col = report.get("collector")
+    if isinstance(col, dict):
+        lines.append(
+            f"collector: pulls={col.get('pulls', 0)}  "
+            f"events={col.get('events_pulled', 0)}  "
+            f"stitched_traces={col.get('traces', 0)}  "
+            f"spools_recovered={col.get('spools_recovered', 0)}  "
+            f"pull_errors={col.get('pull_errors', 0)}")
     return "\n".join(lines)
 
 

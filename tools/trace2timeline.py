@@ -1,26 +1,32 @@
 #!/usr/bin/env python
-"""Reconstruct one request's journey from a telemetry trace.
+"""Reconstruct one request's journey from telemetry traces.
 
     python tools/trace2timeline.py trace.json[.gz] --list
     python tools/trace2timeline.py trace.json[.gz] --trace-id <id>
+    python tools/trace2timeline.py front.json replica-*.spool.json \\
+                                   --trace-id <id>
 
 Reads the same inputs as tools/trace2summary.py — a Chrome-trace JSON
 array, bare JSONL (``MetricsRegistry.write_trace_jsonl``), or a
-flight-recorder dump, gzipped or not. ``--list`` enumerates every trace
-id present (with event counts and wall span — the menu); ``--trace-id``
-prints that request's chronological timeline:
+flight-recorder dump, gzipped or not — plus replica spool spills
+(``telemetry/spool.py``) and stitched-trace downloads from the fleet
+front door's ``/debug/trace/<id>``. MULTIPLE files merge into one
+chronology (span timestamps are epoch-anchored, so cross-process order
+is real); a file whose wrapper names a ``replica`` stamps it onto its
+events, and events already attributed by the fleet collector keep
+theirs, so the timeline shows who did what:
 
-    +ms        dur_ms  kind    name                    detail
-    +0.000          -  event   http.request            POST /generate
-    +0.412          -  event   generation.submit       prompt_len=3
-    +1.003          -  event   generation.admit        slot=0 queue_ms=0.6
-    +6.410      5.2    span    generation.prefill      batch=1 rung=32
-    +8.001          -  event   generation.decode_step  slot=0 token_index=1
+    +ms        dur_ms  replica  kind    name                  detail
+    +0.000          -  front    event   fleet.request         POST /generate
+    +0.412          -  front    event   fleet.route           replica=f0
+    +1.003          -  f0       event   generation.admit      slot=0
+    +6.410      5.2    f0       span    generation.prefill    batch=1
     ...
 
 which answers "why was THIS request slow" — a long queue_ms means
 admission backlog, a fat prefill span means a cold rung, sparse decode
-steps mean the loop was starved.
+steps mean the loop was starved, and the replica column shows the hop
+where the time went.
 
 Like trace2summary, this file must stay importable without the package
 (no jax): stdlib only.
@@ -35,11 +41,41 @@ from typing import Dict, List, Optional
 # shared loaders live in trace2summary; fall back to a package-relative
 # import when run as `python -m tools.trace2timeline`
 try:
-    from trace2summary import filter_trace_id, load_events
+    from trace2summary import _read_text, filter_trace_id, load_events
 except ImportError:                                    # pragma: no cover
-    from tools.trace2summary import filter_trace_id, load_events
+    from tools.trace2summary import (_read_text, filter_trace_id,
+                                     load_events)
 
-_SKIP_DETAIL_KEYS = ("path", "trace_id")
+_SKIP_DETAIL_KEYS = ("path", "trace_id", "replica")
+
+
+def load_stamped(path: str) -> List[dict]:
+    """``load_events`` plus replica attribution: a spool spill (or any
+    dict wrapper) naming a top-level ``replica`` stamps it onto each of
+    its events — unless the event already carries ``args.replica`` (the
+    fleet collector's stitched downloads do; theirs wins)."""
+    events = load_events(path)
+    replica = None
+    try:
+        data = json.loads(_read_text(path).strip() or "null")
+        if isinstance(data, dict):
+            replica = data.get("replica")
+    except (OSError, ValueError):
+        pass
+    if replica:
+        for e in events:
+            if isinstance(e, dict):
+                e.setdefault("args", {}).setdefault("replica", replica)
+    return events
+
+
+def load_merged(paths: List[str]) -> List[dict]:
+    """All files' events in one pool (stamped); ``timeline``/``list_traces``
+    sort by ``ts`` so per-file order does not matter."""
+    out: List[dict] = []
+    for p in paths:
+        out.extend(load_stamped(p))
+    return out
 
 
 def list_traces(events: List[dict]) -> List[dict]:
@@ -57,6 +93,8 @@ def list_traces(events: List[dict]) -> List[dict]:
         first = min(evs, key=lambda e: e.get("ts", 0))
         rows.append({"trace_id": tid, "events": len(evs),
                      "first_name": first.get("name", "?"),
+                     "replicas": sorted({e.get("args", {}).get("replica")
+                                         for e in evs} - {None, ""}),
                      "t0": t0,
                      "span_ms": round((t1 - t0) / 1e3, 3)})
     rows.sort(key=lambda r: r["t0"])
@@ -82,6 +120,7 @@ def timeline(events: List[dict], trace_id: str) -> List[dict]:
             "t_ms": round((e.get("ts", 0) - t0) / 1e3, 3),
             "dur_ms": (round(e.get("dur", 0) / 1e3, 3)
                        if e.get("ph") == "X" else None),
+            "replica": args.get("replica", ""),
             "kind": e.get("cat", e.get("ph", "?")),
             "name": e.get("name", "?"),
             "path": args.get("path", ""),
@@ -95,12 +134,19 @@ def format_timeline(rows: List[dict]) -> str:
         return "(no events for that trace id)"
     wn = max(max(len(r["name"]) for r in rows), len("name"))
     wk = max(max(len(r["kind"]) for r in rows), len("kind"))
-    head = (f"{'+ms':>10}  {'dur_ms':>8}  {'kind':<{wk}}  "
+    # the replica column appears only when attribution exists — a
+    # single-process trace renders exactly as before
+    with_replica = any(r.get("replica") for r in rows)
+    wr = (max(max(len(r.get("replica", "")) for r in rows), len("replica"))
+          if with_replica else 0)
+    rep_head = f"{'replica':<{wr}}  " if with_replica else ""
+    head = (f"{'+ms':>10}  {'dur_ms':>8}  {rep_head}{'kind':<{wk}}  "
             f"{'name':<{wn}}  detail")
     lines = [head, "-" * len(head)]
     for r in rows:
         dur = f"{r['dur_ms']:.3f}" if r["dur_ms"] is not None else "-"
-        lines.append(f"{r['t_ms']:>10.3f}  {dur:>8}  "
+        rep = f"{r.get('replica', ''):<{wr}}  " if with_replica else ""
+        lines.append(f"{r['t_ms']:>10.3f}  {dur:>8}  {rep}"
                      f"{r['kind']:<{wk}}  {r['name']:<{wn}}  {r['detail']}")
     return "\n".join(lines)
 
@@ -108,19 +154,28 @@ def format_timeline(rows: List[dict]) -> str:
 def format_listing(rows: List[dict]) -> str:
     if not rows:
         return "(no trace ids in trace — was a TraceContext active?)"
+    with_replicas = any(r.get("replicas") for r in rows)
     head = f"{'trace_id':<34}  {'events':>7}  {'span_ms':>10}  first_event"
+    if with_replicas:
+        head += "  replicas"
     lines = [head, "-" * len(head)]
     for r in rows:
-        lines.append(f"{r['trace_id']:<34}  {r['events']:>7}  "
-                     f"{r['span_ms']:>10.2f}  {r['first_name']}")
+        line = (f"{r['trace_id']:<34}  {r['events']:>7}  "
+                f"{r['span_ms']:>10.2f}  {r['first_name']}")
+        if with_replicas:
+            line += f"  {','.join(r.get('replicas', []))}"
+        lines.append(line)
     return "\n".join(lines)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Per-request timeline from a telemetry trace")
-    ap.add_argument("trace", help="trace file (JSON array, JSONL, or "
-                                  "flight-recorder dump; .gz ok)")
+    ap.add_argument("trace", nargs="+",
+                    help="trace file(s) (JSON array, JSONL, flight-"
+                         "recorder dump, replica spool, or stitched "
+                         "/debug/trace/<id> download; .gz ok; multiple "
+                         "files merge into one cross-process timeline)")
     ap.add_argument("--trace-id", default=None,
                     help="the request to reconstruct")
     ap.add_argument("--list", action="store_true",
@@ -129,7 +184,7 @@ def main(argv=None) -> int:
                     help="emit JSON instead of a table")
     args = ap.parse_args(argv)
 
-    events = load_events(args.trace)
+    events = load_merged(args.trace)
     if args.list or not args.trace_id:
         rows = list_traces(events)
         print(json.dumps(rows, indent=2) if args.json

@@ -2624,6 +2624,121 @@ print(json.dumps(out))
     return json.loads(lines[-1])
 
 
+def bench_tensor_parallel(train_batches=6, decode_steps=40, timeout=420,
+                          d_model=32, n_blocks=2):
+    """Tensor-parallel (data, model) meshes (parallel/tensor_parallel.py)
+    on the 8-virtual-CPU mesh: the same transformer-LM trained on a
+    (4, 1) pure-data mesh vs a (2, 2) mesh (model axis shards attention
+    heads / MLP width), and one decode loop sharded (1, 2) vs
+    replicated.
+
+    Reported per leg: median step/decode-step wall time plus the numbers
+    the tier is bought for — per-replica param+updater bytes (training)
+    and KV-pool bytes per chip (decode), both =~ m lower on the sharded
+    mesh. CPU wall times measure collective launch overhead only (a
+    head-sharded matmul on shared host cores is not faster); real ICI is
+    where the m-x memory headroom converts to bigger models per chip.
+    Runs in a subprocess so the CPU platform doesn't poison this
+    process."""
+    code = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+from deeplearning4j_tpu.parallel import ParallelWrapper, per_replica_bytes
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.serving.generation.programs import (
+    GenerationConfig, GenerationProgramSet)
+
+N_BATCHES = %(batches)d
+DECODE_STEPS = %(decode)d
+V = 41
+
+def lm(seed=7, max_length=48):
+    net = transformer_lm(vocab_size=V, d_model=%(d_model)d, n_heads=4,
+                         n_blocks=%(n_blocks)d,
+                         max_length=max_length, seed=seed, token_input=True)
+    return net.init()
+
+rs = np.random.RandomState(0)
+data = [DataSet(rs.randint(1, V, (8, 16)).astype(np.int32),
+                np.eye(V)[rs.randint(0, V, (8, 16))].astype(np.float32))
+        for _ in range(N_BATCHES)]
+
+out = {}
+for label, shape in (("4x1", (4, 1)), ("2x2", (2, 2))):
+    net = lm()
+    pw = ParallelWrapper(net, mesh_shape=shape)
+    pw.fit(data[:1], epochs=1)              # compile + warm
+    t0 = time.perf_counter()
+    pw.fit(data, epochs=1)
+    dt = time.perf_counter() - t0
+    out[label] = {
+        "step_ms": round(dt / N_BATCHES * 1e3, 3),
+        "param_bytes_per_replica": per_replica_bytes(net.params),
+        "opt_bytes_per_replica": per_replica_bytes(net.opt_state)}
+out["train_bytes_reduction"] = round(
+    (out["4x1"]["param_bytes_per_replica"]
+     + out["4x1"]["opt_bytes_per_replica"])
+    / max(1, out["2x2"]["param_bytes_per_replica"]
+          + out["2x2"]["opt_bytes_per_replica"]), 3)
+
+cfg = dict(block_len=8, max_seq_len=32, decode_slots=8,
+           prefill_batches=(1,))
+net = lm(max_length=32)
+dec = {}
+for label, mesh in (("replicated", None),
+                    ("sharded", make_mesh((1, 2), ("data", "model"),
+                                          jax.devices()[:2]))):
+    ps = GenerationProgramSet(net, config=GenerationConfig(**cfg),
+                              mesh=mesh).warm()
+    cache, key = ps.make_cache(), ps.fresh_key()
+    S = cfg["decode_slots"]
+    mb = ps.config.blocks_per_seq
+    toks = np.zeros((S,), np.int32)
+    pos = np.zeros((S,), np.int32)
+    tables = np.zeros((S, mb), np.int32)
+    active = np.ones((S,), np.bool_)
+    temp = np.zeros((S,), np.float32)
+    topk = np.zeros((S,), np.int32)
+    t0 = time.perf_counter()
+    for _ in range(DECODE_STEPS):
+        t, cache, key = ps.run_decode(cache, toks, pos, tables, active,
+                                      key, temp, topk)
+    jax.block_until_ready(cache)
+    dt = time.perf_counter() - t0
+    dec[label] = {
+        "tokens_per_sec": round(S * DECODE_STEPS / dt, 1),
+        "decode_step_ms": round(dt / DECODE_STEPS * 1e3, 3),
+        "kv_pool_bytes_per_chip": ps.kv_pool_chip_bytes}
+out["decode"] = dec
+out["kv_pool_reduction"] = round(
+    dec["replicated"]["kv_pool_bytes_per_chip"]
+    / max(1, dec["sharded"]["kv_pool_bytes_per_chip"]), 3)
+out["note"] = ("virtual CPU devices: (2,2) vs (4,1) training and "
+               "(1,2)-sharded vs replicated decode; the m-x per-chip "
+               "bytes reductions are the acceptance numbers, wall "
+               "times only bound collective launch overhead")
+print(json.dumps(out))
+""" % {"batches": int(train_batches), "decode": int(decode_steps),
+       "d_model": int(d_model), "n_blocks": int(n_blocks)}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=timeout, env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(f"tensor-parallel subprocess failed "
+                           f"(rc={out.returncode}): "
+                           f"{out.stderr.strip()[-500:]}")
+    return json.loads(lines[-1])
+
+
 def bench_collective_overhead():
     """Collective-overhead breakdown per mesh shape on VIRTUAL CPU devices
     (BASELINE #5 — real chips unavailable, so chip-scaling efficiency is
@@ -3028,6 +3143,7 @@ def main():
             ("threshold_encode_ms_25m", bench_threshold_encode),
             ("collective_overlap", bench_collective_overlap),
             ("zero_sharded_update", bench_zero_sharded_update),
+            ("tensor_parallel", bench_tensor_parallel),
             ("collective_overhead_by_mesh", bench_collective_overhead),
             ("resnet50_amp_img_per_sec", _amp_ours),
             ("resnet50_piped_img_per_sec", _piped),
@@ -3054,7 +3170,8 @@ def main():
         # the collective rows manage their own subprocess timeouts
         cap = 460.0 if name in ("collective_overhead_by_mesh",
                                 "collective_overlap",
-                                "zero_sharded_update") else \
+                                "zero_sharded_update",
+                                "tensor_parallel") else \
             min(row_cap, budget - elapsed + 60.0)
         signal.setitimer(signal.ITIMER_REAL, cap)
         try:

@@ -124,6 +124,13 @@ class TransformerDecodeSpec:
         self.max_length = self._v["pos"].layer_conf.max_length
         self.dtype = jnp.dtype(net.conf.dtype)
 
+    def supports_head_sharding(self, m: int) -> bool:
+        """Whether the paged KV pools (and the Q/K/V/O projections) can
+        split their head axis ``m`` ways: attention is head-local, so an
+        even head split keeps every per-head row on one shard and decode
+        stays token-for-token identical to the single-chip program."""
+        return m >= 1 and self.n_heads % m == 0
+
     # index/param helpers ---------------------------------------------------
     def vi(self, name: str) -> int:
         return self._idx[name]
@@ -301,6 +308,11 @@ class LSTMDecodeSpec:
         self.n_in = net.layers[0].n_in
         self.dtype = jnp.dtype(net.conf.dtype)
         self.token_input = False          # char-LM contract: one-hot input
+
+    def supports_head_sharding(self, m: int) -> bool:
+        """The recurrent-state cache has no head axis to shard — only the
+        degenerate m=1 'split' is supported."""
+        return m == 1
 
     def init_states(self, batch: int):
         """Zero-filled recurrent-state carry for ``batch`` sequences, with

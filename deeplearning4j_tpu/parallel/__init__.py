@@ -4,6 +4,11 @@ from .inference import ParallelInference
 from .overlap import (BucketSchedule, GradBucket, build_bucket_schedule,
                       bucketed_pmean, fused_pmean, profile_schedule)
 from .zero import ZeroUpdateEngine, is_zero_state, make_zero_resharder
+from .tensor_parallel import (MODEL_AXIS, build_param_specs,
+                              build_param_shardings, host_gather,
+                              model_axis_size, per_replica_bytes,
+                              shard_params, sharded_leaf_count)
+from .resharding import make_any_resharder, redistribute
 from .elastic import ElasticTrainer, RecoveryFailedError
 from .faults import (CoordinationError, CoordinationFlake, CorruptCheckpoint,
                      FaultInjector, FaultPlan, KillWorker, PreemptAt,
@@ -14,6 +19,10 @@ __all__ = ["data_sharding", "make_mesh", "replicated", "window_sharding",
            "BucketSchedule", "GradBucket", "build_bucket_schedule",
            "bucketed_pmean", "fused_pmean", "profile_schedule",
            "ZeroUpdateEngine", "is_zero_state", "make_zero_resharder",
+           "MODEL_AXIS", "build_param_specs", "build_param_shardings",
+           "host_gather", "model_axis_size", "per_replica_bytes",
+           "shard_params", "sharded_leaf_count",
+           "make_any_resharder", "redistribute",
            "ElasticTrainer", "RecoveryFailedError",
            "FaultInjector", "FaultPlan", "KillWorker", "SlowCollective",
            "CorruptCheckpoint", "PreemptAt", "CoordinationFlake",

@@ -43,6 +43,9 @@ from .mesh import (data_sharding, make_mesh, replicated, shard_map,
                    window_sharding)
 from .overlap import (DEFAULT_BUCKET_BYTES, build_bucket_schedule,
                       bucketed_pmean, fused_pmean)
+from .tensor_parallel import (MODEL_AXIS, build_opt_shardings,
+                              build_param_specs, build_param_shardings,
+                              model_axis_size, per_replica_bytes)
 from .zero import ZeroUpdateEngine, is_zero_state
 
 
@@ -101,7 +104,9 @@ class ParallelWrapper:
     per-worker carry has no replicated equivalent).
     """
 
-    def __init__(self, net, *, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
+    def __init__(self, net, *, mesh: Optional[Mesh] = None,
+                 mesh_shape: Optional[tuple] = None,
+                 workers: Optional[int] = None,
                  averaging_frequency: int = 1, training_mode: str = "shared_gradients",
                  average_updaters: bool = True, prefetch_buffer: int = 2,
                  report_score_after_averaging: bool = True,
@@ -112,11 +117,31 @@ class ParallelWrapper:
                  step_callback=None):
         self.net = net
         devices = jax.devices()
+        if mesh is not None and mesh_shape is not None:
+            raise ValueError("pass mesh OR mesh_shape, not both")
         if workers is not None and mesh is None:
             devices = devices[:workers]
-            mesh = make_mesh((len(devices),), ("data",), devices)
+            if mesh_shape is None:
+                mesh = make_mesh((len(devices),), ("data",), devices)
+        if mesh_shape is not None:
+            # (d,) is the 1-D data mesh; (d, m) adds the Megatron-style
+            # model axis (parallel/tensor_parallel.py) — m=1 keeps the
+            # axis in the mesh but every program stays bit-identical to
+            # the 1-D path (the tp spec table is empty at m=1).
+            if len(mesh_shape) == 1:
+                mesh = make_mesh(tuple(mesh_shape), ("data",), devices)
+            elif len(mesh_shape) == 2:
+                mesh = make_mesh(tuple(mesh_shape), ("data", MODEL_AXIS),
+                                 devices)
+            else:
+                raise ValueError(f"mesh_shape must be (d,) or (d, m), "
+                                 f"got {mesh_shape}")
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n = self.mesh.devices.size
+        # batch-divisibility and worker accounting follow the DATA axis
+        # only — the model axis replicates the batch
+        _sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.n = int(_sizes.get("data", self.mesh.devices.size))
+        self.m = model_axis_size(self.mesh)
         self.averaging_frequency = max(1, averaging_frequency)
         self.training_mode = training_mode.lower()
         self.average_updaters = average_updaters
@@ -131,6 +156,21 @@ class ParallelWrapper:
                 "path (training_mode='shared_gradients'), not K-step parameter "
                 "averaging — the reference makes the same split "
                 "(ParallelWrapper.TrainingMode AVERAGING vs SHARED_GRADIENTS)")
+        if self.m > 1:
+            if self.training_mode == "averaging" \
+                    and self.averaging_frequency > 1:
+                raise ValueError(
+                    "model-axis sharding applies to the per-step sync "
+                    "path; K-step parameter averaging gives each worker "
+                    "its own full param copy, which a model-sharded "
+                    "layout cannot represent — use "
+                    "training_mode='shared_gradients' on a (data, model) "
+                    "mesh")
+            if gradient_accumulator is not None:
+                raise ValueError(
+                    "a GradientsAccumulator ravels the full per-worker "
+                    "grad tree, which a model-sharded layout cannot feed "
+                    "— drop the accumulator on a (data, model) mesh")
         # Fused K-step dispatch on the sync all-reduce path (the same
         # scan-window program as Solver.fit(steps_per_dispatch=K), with
         # xs/ys landing [K, batch, ...] sharded on the data axis). The
@@ -192,6 +232,12 @@ class ParallelWrapper:
         self._acc_state = None
         self._sync_step = None
         self._sync_window_step = None
+        # tensor-parallel layout (parallel/tensor_parallel.py), built
+        # lazily from net.params: PartitionSpec tree + NamedSharding
+        # trees for params and updater state. None until m > 1 asks.
+        self._tp_specs = None
+        self._tp_param_sh = None
+        self._tp_opt_sh = None
         # Replicated-feed programs for sync batches that don't tile the
         # mesh (shard_map AND jit+in_shardings both enforce batch-dim
         # divisibility): the end-of-epoch remainder the prefetcher ships
@@ -210,6 +256,54 @@ class ParallelWrapper:
         # strand iteration_count behind params mid-item.
         self.step_callback = step_callback
 
+    # --------------------------------------------------- tensor-parallel
+    def _tp_shardings(self):
+        """Param NamedSharding tree for the model axis (Megatron head/
+        width split; tensor_parallel.build_param_specs). Layout hints
+        only — GSPMD owns the collectives."""
+        if self._tp_param_sh is None:
+            self._tp_specs = build_param_specs(self.net, self.m)
+            self._tp_param_sh = build_param_shardings(self.mesh,
+                                                      self._tp_specs)
+        return self._tp_param_sh
+
+    def _tp_opt_shardings(self):
+        """Updater-state NamedSharding tree mirroring the param specs
+        (momentum/velocity slots shard with their param; scalars stay
+        replicated). Materializes ``net.opt_state`` if the net has not
+        trained yet — the tree's structure is the sharding's shape."""
+        if self._tp_opt_sh is None:
+            self._tp_shardings()
+            if self.net.opt_state is None:
+                self.net.opt_state = self.net.updater.init(self.net.params)
+            self._tp_opt_sh = build_opt_shardings(
+                self.mesh, self._tp_specs, self.net.params,
+                self.net.opt_state)
+        return self._tp_opt_sh
+
+    def _auto_axes(self):
+        """shard_map manual-collective builders go over 'data' only; on a
+        2-D mesh the model axis stays GSPMD-managed (auto), so the tp
+        layout hints on the jit boundary shard the math inside the
+        manual region too."""
+        return {"auto": frozenset({MODEL_AXIS})} if self.m > 1 else {}
+
+    def _jit_manual(self, fn, feed_sh, opt_sh=None):
+        """jit a shard_map-built step. 1-D path: exactly the historical
+        ``jax.jit(fn, donate_argnums=(0, 2))``. 2-D path: the tp layout
+        hints ride the jit boundary (params/opt model-sharded at rest,
+        feeds on the data axis) so the auto model axis inside the manual
+        region inherits them."""
+        if self.m == 1:
+            return jax.jit(fn, donate_argnums=(0, 2))
+        rep = replicated(self.mesh)
+        psh = self._tp_shardings()
+        osh = opt_sh if opt_sh is not None else self._tp_opt_shardings()
+        return jax.jit(fn, donate_argnums=(0, 2),
+                       in_shardings=(psh, rep, osh, rep, rep,
+                                     feed_sh, feed_sh),
+                       out_shardings=(psh, rep, osh, rep))
+
     # ------------------------------------------------------------- sync path
     def _build_sync_step(self, feed_sharding=None):
         """Per-step all-reduce DP: jit over the mesh, batch sharded.
@@ -225,10 +319,12 @@ class ParallelWrapper:
         rep = replicated(mesh)
         dsh = feed_sharding if feed_sharding is not None \
             else data_sharding(mesh)
+        psh = self._tp_shardings() if self.m > 1 else rep
+        osh = self._tp_opt_shardings() if self.m > 1 else rep
         return jax.jit(
             step, donate_argnums=(0, 2),
-            in_shardings=(rep, rep, rep, rep, rep, dsh, dsh),
-            out_shardings=(rep, rep, rep, rep))
+            in_shardings=(psh, rep, osh, rep, rep, dsh, dsh),
+            out_shardings=(psh, rep, osh, rep))
 
     def _build_sync_window_step(self, feed_sharding=None):
         """K fused sync-DP steps in ONE jitted lax.scan program: xs/ys are
@@ -256,10 +352,12 @@ class ParallelWrapper:
         rep = replicated(mesh)
         wsh = feed_sharding if feed_sharding is not None \
             else window_sharding(mesh)   # [K, batch, ...]
+        psh = self._tp_shardings() if self.m > 1 else rep
+        osh = self._tp_opt_shardings() if self.m > 1 else rep
         return jax.jit(
             window_step, donate_argnums=(0, 2),
-            in_shardings=(rep, rep, rep, rep, rep, wsh, wsh),
-            out_shardings=(rep, rep, rep, rep))
+            in_shardings=(psh, rep, osh, rep, rep, wsh, wsh),
+            out_shardings=(psh, rep, osh, rep))
 
     # -------------------------------------------------- overlapped sync path
     def _grad_schedule(self):
@@ -299,8 +397,9 @@ class ParallelWrapper:
         rep, dsh = P(), P("data")
         fn = shard_map(worker_step, mesh=mesh,
                        in_specs=(rep, rep, rep, rep, rep, dsh, dsh),
-                       out_specs=(rep, rep, rep, rep), check_vma=False)
-        return jax.jit(fn, donate_argnums=(0, 2))
+                       out_specs=(rep, rep, rep, rep), check_vma=False,
+                       **self._auto_axes())
+        return self._jit_manual(fn, data_sharding(mesh))
 
     def _build_overlap_window_step(self):
         """K fused steps of the bucketed-overlap sync path in ONE lax.scan
@@ -330,8 +429,9 @@ class ParallelWrapper:
         rep, wsh = P(), P(None, "data")
         fn = shard_map(window_step, mesh=mesh,
                        in_specs=(rep, rep, rep, rep, rep, wsh, wsh),
-                       out_specs=(rep, rep, rep, rep), check_vma=False)
-        return jax.jit(fn, donate_argnums=(0, 2))
+                       out_specs=(rep, rep, rep, rep), check_vma=False,
+                       **self._auto_axes())
+        return self._jit_manual(fn, window_sharding(mesh))
 
     # --------------------------------------------------- zero sharded path
     def _zero(self) -> ZeroUpdateEngine:
@@ -375,10 +475,20 @@ class ParallelWrapper:
         rep = P()
         osh = P("data")                      # [N, L] state shards
         dsh = rep if replicated_feed else P("data")
+        # NOTE: no auto model axis here — the engine's axis_index /
+        # psum_scatter collectives only lower under a fully-manual
+        # region. On a (data, model) mesh the flat update stays sharded
+        # d ways over 'data' (replicated across model); params are
+        # model-sharded AT REST via the jit boundary and gathered for
+        # the step — the at-rest m× memory win composes, the compute
+        # inside the zero step does not.
         fn = shard_map(worker_step, mesh=mesh,
                        in_specs=(rep, rep, osh, rep, rep, dsh, dsh),
                        out_specs=(rep, rep, osh, rep), check_vma=False)
-        return jax.jit(fn, donate_argnums=(0, 2))
+        return self._jit_manual(
+            fn,
+            replicated(mesh) if replicated_feed else data_sharding(mesh),
+            opt_sh=NamedSharding(mesh, osh))
 
     def _build_zero_window_step(self, replicated_feed: bool = False):
         """K fused zero-sharded steps in ONE lax.scan program: the scan
@@ -407,10 +517,14 @@ class ParallelWrapper:
 
         rep, osh = P(), P("data")
         wsh = rep if replicated_feed else P(None, "data")
+        # fully-manual for the same reason as _build_zero_step
         fn = shard_map(window_step, mesh=mesh,
                        in_specs=(rep, rep, osh, rep, rep, wsh, wsh),
                        out_specs=(rep, rep, osh, rep), check_vma=False)
-        return jax.jit(fn, donate_argnums=(0, 2))
+        return self._jit_manual(
+            fn,
+            replicated(mesh) if replicated_feed else window_sharding(mesh),
+            opt_sh=NamedSharding(mesh, osh))
 
     def _remainder_step_fn(self):
         """The sync step with x/y REPLICATED: serves batches whose size
@@ -620,6 +734,15 @@ class ParallelWrapper:
                                     feed, dtype, base_rng, perf, sync, reg,
                                     skip=(skip_first_batches
                                           if epoch == 0 else 0))
+            if self.m > 1 and reg.enabled:
+                # per-replica footprint after the layout hints settled:
+                # model-sharded leaves contribute 1/m of their bytes —
+                # the ≈m× reduction the tp memory claim gauges
+                reg.gauge("parallel.model_axis").set(self.m)
+                reg.gauge("parallel.param_bytes_per_replica").set(
+                    per_replica_bytes(net.params))
+                reg.gauge("parallel.opt_bytes_per_replica").set(
+                    per_replica_bytes(net.opt_state))
         return net
 
     def _fit_epoch(self, net, it_wrapped, prefetcher, iterator, feed, dtype,

@@ -58,7 +58,10 @@ from .data_parallel import ParallelWrapper
 from .faults import CoordinationError, FaultInjector, WorkerLostError
 from .mesh import make_mesh, replicated
 from .overlap import DEFAULT_BUCKET_BYTES
-from .zero import ZeroUpdateEngine, make_zero_resharder
+from .resharding import make_any_resharder
+from .tensor_parallel import (build_opt_shardings, build_param_shardings,
+                              build_param_specs, model_axis_size)
+from .zero import ZeroUpdateEngine
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -119,6 +122,7 @@ class ElasticTrainer:
 
     def __init__(self, net, *, checkpoint_dir: Optional[str] = None,
                  devices: Optional[List] = None,
+                 mesh_shape: Optional[tuple] = None,
                  checkpoint_every_n_steps: int = 50, keep_last: int = 3,
                  steps_per_dispatch: int = 1, prefetch_buffer: int = 0,
                  max_recoveries: int = 8,
@@ -165,9 +169,33 @@ class ElasticTrainer:
         self._reg = registry if registry is not None else get_registry()
         self._all_devices = list(devices if devices is not None
                                  else jax.devices())
-        self._devices = list(self._all_devices)
-        self._mesh = make_mesh((len(self._devices),), ("data",),
-                               self._devices)
+        # (data, model) tensor-parallel mesh (tensor_parallel.py). The
+        # degraded averaging mode holds full per-worker param copies,
+        # which a model-sharded layout cannot represent — refuse the
+        # combination like the zero one above.
+        if mesh_shape is not None and len(mesh_shape) not in (1, 2):
+            raise ValueError(f"mesh_shape must be (d,) or (d, m), "
+                             f"got {mesh_shape}")
+        self._mesh_shape = tuple(mesh_shape) if mesh_shape else None
+        if self._mesh_shape is not None and len(self._mesh_shape) == 2 \
+                and self._mesh_shape[1] > 1 \
+                and sync_latency_budget_ms is not None:
+            raise ValueError(
+                "a model-sharded mesh does not compose with the degraded "
+                "averaging-window mode (sync_latency_budget_ms): "
+                "averaging needs full per-worker param copies")
+        if self._mesh_shape is not None:
+            need = 1
+            for s in self._mesh_shape:
+                need *= int(s)
+            if need > len(self._all_devices):
+                raise ValueError(f"mesh_shape {self._mesh_shape} needs "
+                                 f"{need} devices, have "
+                                 f"{len(self._all_devices)}")
+            self._devices = list(self._all_devices[:need])
+        else:
+            self._devices = list(self._all_devices)
+        self._mesh = self._mesh_for(self._devices)
         self._wrappers = {}
         self._writer: Optional[AsyncCheckpointWriter] = None
         self._preempt_flag = False
@@ -200,6 +228,28 @@ class ElasticTrainer:
         clean preemption path (final checkpoint flush + clean return)."""
         kw = {} if signals is None else {"signals": signals}
         return PreemptionGuard(on_preempt=self._on_preempt, **kw)
+
+    # ----------------------------------------------------------------- mesh
+    def _mesh_for(self, devices) -> Any:
+        """Mesh-shape policy over a (possibly shrunk) device set. 1-D
+        trainers keep the historical all-data mesh. A (d, m) trainer
+        keeps its shape while the devices last; after a shrink it keeps
+        the DATA axis and shrinks the model axis when the survivors
+        still tile it — (2, 2) on 3 dead chips re-forms as (2, 1), and
+        the generalized resharder redistributes the model-sharded
+        checkpoint onto the new layout instead of aborting — falling
+        back to (n, 1) otherwise. The model axis stays in the mesh
+        either way so the recovery programs keep one axis vocabulary."""
+        n = len(devices)
+        shape = self._mesh_shape
+        if shape is None or len(shape) == 1:
+            return make_mesh((n,), ("data",), devices)
+        d, m = int(shape[0]), int(shape[1])
+        if n == d * m:
+            return make_mesh((d, m), ("data", "model"), devices)
+        if n % d == 0 and n // d <= m:
+            return make_mesh((d, n // d), ("data", "model"), devices)
+        return make_mesh((n, 1), ("data", "model"), devices)
 
     # -------------------------------------------------------------- wrappers
     def _wrapper(self) -> ParallelWrapper:
@@ -239,7 +289,8 @@ class ElasticTrainer:
         """The ZeRO layout for ``mesh`` (cached per device set — the
         layout is host metadata, but the init/like state it builds must
         carry the right mesh's shardings)."""
-        key = (mesh.devices.size, tuple(d.id for d in mesh.devices.flat))
+        key = (tuple(mesh.devices.shape),
+               tuple(d.id for d in mesh.devices.flat))
         eng = self._engines.get(key)
         if eng is None:
             eng = self._engines[key] = ZeroUpdateEngine.from_net(
@@ -252,26 +303,43 @@ class ElasticTrainer:
                 if self.zero_stage else None)
 
     def _resharder(self, mesh):
-        """Restore hook: zero-sharded updater state saved on a different
-        mesh size re-shards (all-gather -> re-slice) onto ``mesh``
-        instead of failing the restore."""
-        return (make_zero_resharder(self._engine_for(mesh))
-                if self.zero_stage else None)
+        """Restore hook (parallel/resharding.py): ANY saved layout —
+        other mesh topologies, model-sharded params, zero-flat state on
+        a different data-axis size — redistributes onto ``mesh`` instead
+        of failing the restore."""
+        return make_any_resharder(
+            self._engine_for(mesh) if self.zero_stage else None)
 
     def _like_tree(self, mesh) -> dict:
         """Restore target: the current train state re-homed on ``mesh``
-        (params/state replicated; zero updater state in the engine's
+        (params on their tp layout when the mesh has a model axis, else
+        replicated; state replicated; zero updater state in the engine's
         [N, L] data-axis-sharded layout for that mesh) — supplies both
         the tree structure and the target shardings for
         restore_sharded_checkpoint."""
         rep = replicated(mesh)
         put = lambda t: jax.tree.map(
             lambda a: jax.device_put(jnp.asarray(a), rep), t)
+        m = model_axis_size(mesh)
+        specs = build_param_specs(self.net, m) if m > 1 else None
+        if specs is not None:
+            psh = build_param_shardings(mesh, specs)
+            params_like = jax.tree.map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                self.net.params, psh)
+        else:
+            params_like = put(self.net.params)
         if self.zero_stage:
             opt_like = self._engine_for(mesh).init_opt_state()
+        elif specs is not None and self.net.opt_state is not None:
+            osh = build_opt_shardings(mesh, specs, self.net.params,
+                                      self.net.opt_state)
+            opt_like = jax.tree.map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                self.net.opt_state, osh)
         else:
             opt_like = put(self.net.opt_state)
-        return {"params": put(self.net.params),
+        return {"params": params_like,
                 "state": put(self.net.state),
                 "opt": opt_like}
 
@@ -399,7 +467,7 @@ class ElasticTrainer:
                            else list(self._all_devices))
                 if not devices:
                     raise RecoveryFailedError("no surviving workers")
-                mesh = make_mesh((len(devices),), ("data",), devices)
+                mesh = self._mesh_for(devices)
                 like = self._like_tree(mesh)
                 if self.checkpoint_dir is not None:
                     step, tree, extra = restore_latest_sharded_checkpoint(

@@ -1,0 +1,196 @@
+"""Megatron-style tensor-parallel layout rules for the ``model`` mesh axis.
+
+The reference stack has no model-parallel story (ParallelWrapper.java and
+the Spark masters shard BATCHES, never weights); this module is the
+net-new layer that makes ``(data, model)`` meshes first-class. The split
+is the standard head/width recipe (arXiv 1909.08053): attention Q/K/V
+projections column-parallel, the output projection row-parallel, MLP
+ff1 column- / ff2 row-parallel, and LSTM gate blocks (the 4H gate dim)
+column-parallel — everything else (embeddings, layernorms, heads,
+biases feeding row-parallel matmuls, peepholes) replicated.
+
+Crucially these are GSPMD *layout hints*, not manual collectives: the
+specs go into ``jax.jit`` ``in_shardings``/``out_shardings`` (or ride a
+``shard_map(..., auto={'model'})`` region) and XLA inserts the
+all-reduces after every row-parallel matmul. Correctness is therefore
+independent of the rules below — a leaf the rules leave replicated is
+merely not memory-sharded. That is what lets the same rule table serve
+the transformer LM, the LSTM stacks, and any future zoo entry without a
+per-model parallelism implementation, and what keeps the ``m=1`` path
+bit-identical to the 1-D programs (an empty spec table == today's
+replicated layout).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+_LSTM_LAYER_TYPES = ("GravesLSTM", "LSTM", "GravesBidirectionalLSTM")
+
+
+def model_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the ``model`` axis (1 when the mesh is 1-D / None)."""
+    if mesh is None or MODEL_AXIS not in mesh.axis_names:
+        return 1
+    return int(dict(zip(mesh.axis_names,
+                        mesh.devices.shape))[MODEL_AXIS])
+
+
+def _is_spec_leaf(x) -> bool:
+    return isinstance(x, P)
+
+
+def _attn_spec(key: str, shape, m: int) -> P:
+    # Wq/Wk/Wv [d_model, d_model] column-parallel: the head dim lives in
+    # the output columns, so slicing columns slices whole heads when
+    # n_heads % m == 0 (callers gate on that for the decode pool; for
+    # training GSPMD is correct either way).
+    if key in ("Wq", "Wk", "Wv") and len(shape) == 2 and shape[1] % m == 0:
+        return P(None, MODEL_AXIS)
+    # Wo [d_model, d_model] row-parallel: consumes the head-sharded
+    # activation; XLA inserts the psum after the partial matmul.
+    if key == "Wo" and len(shape) == 2 and shape[0] % m == 0:
+        return P(MODEL_AXIS, None)
+    return P()       # attention bias rides the post-psum add: replicated
+
+
+def _ff_spec(vertex: str, key: str, shape, m: int) -> P:
+    if vertex.endswith("_ff1"):
+        if key == "W" and len(shape) == 2 and shape[1] % m == 0:
+            return P(None, MODEL_AXIS)
+        if key == "b" and len(shape) == 1 and shape[0] % m == 0:
+            return P(MODEL_AXIS)      # adds onto the column-sharded hidden
+    if vertex.endswith("_ff2"):
+        if key == "W" and len(shape) == 2 and shape[0] % m == 0:
+            return P(MODEL_AXIS, None)
+        # ff2 bias adds after the row-parallel psum: replicated
+    return P()
+
+
+def _lstm_spec(key: str, shape, m: int) -> P:
+    # W [n_in, 4H] / R [H, 4H]: the gate blocks live in the 4H output
+    # columns — column-parallel, with the bias sharded to match. The
+    # H-sized peepholes stay replicated (they multiply the cell state,
+    # which GSPMD keeps consistent across the psum boundary either way).
+    if key in ("W", "R", "U") and len(shape) == 2 and shape[1] % m == 0:
+        return P(None, MODEL_AXIS)
+    if key == "b" and len(shape) == 1 and shape[0] % m == 0:
+        return P(MODEL_AXIS)
+    return P()
+
+
+def build_param_specs(net, m: int) -> Any:
+    """PartitionSpec tree matching ``net.params``. ``m`` is the model-axis
+    size; at ``m == 1`` (or a net with nothing shardable) every leaf is
+    ``P()`` — exactly the replicated layout of the 1-D path. Leaves whose
+    shard dim does not divide by ``m`` fall back to ``P()`` individually,
+    so an odd head count degrades that one layer, not the mesh."""
+    params = net.params
+    if params is None:
+        raise ValueError("net has no params — call net.init() first")
+
+    def leaf_spec(rule):
+        def per_vertex(name, p):
+            if not hasattr(p, "items"):
+                return jax.tree.map(lambda _: P(), p)
+            return {k: (rule(name, k, np.shape(v)) if m > 1 else P())
+                    for k, v in p.items()}
+        return per_vertex
+
+    names = None
+    if hasattr(net, "vertex_names"):          # ComputationGraph
+        names = list(net.vertex_names)
+
+        def rule(name, key, shape):
+            if name.endswith("_attn"):
+                return _attn_spec(key, shape, m)
+            if name.endswith("_ff1") or name.endswith("_ff2"):
+                return _ff_spec(name, key, shape, m)
+            return P()
+    elif hasattr(net.conf, "layers"):         # MultiLayerNetwork
+        layers = list(net.conf.layers)
+        names = [type(l).__name__ for l in layers]
+
+        def rule(name, key, shape):
+            if name in _LSTM_LAYER_TYPES:
+                return _lstm_spec(key, shape, m)
+            return P()
+    else:
+        return jax.tree.map(lambda _: P(), params)
+    per = leaf_spec(rule)
+    return tuple(per(nm, p) for nm, p in zip(names, params))
+
+
+def build_param_shardings(mesh: Mesh, specs) -> Any:
+    """NamedSharding tree from a spec tree (``is_leaf`` on PartitionSpec —
+    a P is itself a tuple, so the default flatten would explode it)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=_is_spec_leaf)
+
+
+def build_opt_shardings(mesh: Mesh, specs, params, opt_state) -> Any:
+    """NamedSharding tree matching ``opt_state``: each updater-state leaf
+    whose shape equals its param's shape (momentum/velocity slots)
+    inherits the param's spec; anything else (scalar step counts, etc.)
+    stays replicated."""
+    def per(spec, p, st):
+        pshape = np.shape(p)
+        return jax.tree.map(
+            lambda leaf: NamedSharding(
+                mesh, spec if np.shape(leaf) == pshape else P()),
+            st)
+    return jax.tree.map(per, specs, params, opt_state,
+                        is_leaf=_is_spec_leaf)
+
+
+def sharded_leaf_count(specs) -> int:
+    """How many param leaves the rules actually shard (0 == pure dp)."""
+    return sum(1 for s in jax.tree.leaves(specs, is_leaf=_is_spec_leaf)
+               if s != P())
+
+
+def shard_params(mesh: Mesh, params, specs) -> Any:
+    """device_put the param tree onto its tp layout (pure redistribution;
+    values unchanged)."""
+    sh = build_param_shardings(mesh, specs)
+    return jax.tree.map(lambda v, s: jax.device_put(v, s), params, sh)
+
+
+def host_gather(tree) -> Any:
+    """Gather a (possibly model-sharded) tree to host numpy — the seam
+    ``write_model`` and the resharder use. Raises loudly when a leaf is
+    not fully addressable (multi-host: gather on each host would be a
+    silent partial read)."""
+    def per(leaf):
+        if hasattr(leaf, "is_fully_addressable") and \
+                not leaf.is_fully_addressable:
+            raise ValueError(
+                "cannot host-gather a non-fully-addressable array (leaf "
+                f"sharding {getattr(leaf, 'sharding', None)}); gather on "
+                "a process that addresses every shard, or save with "
+                "save_sharded_checkpoint instead")
+        return np.asarray(jax.device_get(leaf))
+    return jax.tree.map(per, tree)
+
+
+def per_replica_bytes(tree, device=None) -> int:
+    """Bytes of ``tree`` resident on ONE device (the first addressable
+    one by default) — the number the m×-reduction gauges report. For a
+    replicated leaf this is the full leaf; for a model-sharded leaf it is
+    1/m of it."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            shards = leaf.addressable_shards
+            if device is None and shards:
+                device = shards[0].device
+            total += sum(np.asarray(s.data).nbytes for s in shards
+                         if s.device == device)
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total

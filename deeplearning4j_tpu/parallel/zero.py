@@ -190,7 +190,12 @@ class ZeroUpdateEngine:
                  bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                  axis: str = "data") -> "ZeroUpdateEngine":
         rules, mults, frozen = _leaf_meta_from_net(net)
-        return cls(net.params, rules, mults, n_shards=mesh.devices.size,
+        # shard over the named axis only: on a (data, model) mesh the
+        # update is sharded d ways along 'data' and replicated across
+        # the model axis (identical to the 1-D layout on a 1-D mesh)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        n_shards = int(sizes.get(axis, mesh.devices.size))
+        return cls(net.params, rules, mults, n_shards=n_shards,
                    stage=stage, bucket_bytes=bucket_bytes, axis=axis,
                    mesh=mesh, frozen_rules=frozen)
 

@@ -30,29 +30,34 @@ class GenerationEngine:
     def __init__(self, net=None, *, model_name: str = "default",
                  config: Optional[GenerationConfig] = None,
                  adapter: str = "auto", warm: bool = True,
-                 watch_recompiles: bool = True, draft=None, **config_kwargs):
+                 watch_recompiles: bool = True, draft=None, mesh=None,
+                 **config_kwargs):
         self._models: Dict[str, ModelRuntime] = {}
         self._default: Optional[str] = None
         self._lock = threading.Lock()
         self._draining = False
         self._trace_count = 0
         self._watch = watch_recompiles
+        self._mesh = mesh          # default (data, model) mesh for add_model
         if net is not None:
             self.add_model(model_name, net, config=config, adapter=adapter,
-                           warm=warm, default=True, draft=draft,
+                           warm=warm, default=True, draft=draft, mesh=mesh,
                            **config_kwargs)
 
     # ------------------------------------------------------------------ models
     def add_model(self, name: str, net, *,
                   config: Optional[GenerationConfig] = None,
                   adapter: str = "auto", warm: bool = True,
-                  default: bool = False, draft=None,
+                  default: bool = False, draft=None, mesh=None,
                   **config_kwargs) -> ModelRuntime:
         """Register a generation model. Per-model opt-ins (ISSUE 14):
         ``draft=`` attaches a speculative-decoding draft model (the
         config's ``spec_k`` proposals per verify window, default 4);
         ``prefix_cache=`` (config/kwarg) disables or forces prompt-prefix
-        KV sharing (default: on for paged-transformer models)."""
+        KV sharing (default: on for paged-transformer models);
+        ``mesh=`` (ISSUE 20) a ``(data, model)`` mesh whose model axis
+        shards the projections and KV pools by head across chips
+        (defaults to the engine-level mesh)."""
         with self._lock:
             if name in self._models:
                 raise ValueError(f"generation model '{name}' already "
@@ -63,7 +68,8 @@ class GenerationEngine:
             ps = GenerationProgramSet(net, config=cfg, adapter=adapter,
                                       draft_net=draft,
                                       trace_hook=self._on_trace,
-                                      cost_path=f"generation.{name}")
+                                      cost_path=f"generation.{name}",
+                                      mesh=mesh or self._mesh)
             if warm:
                 ps.warm()
         finally:
@@ -157,7 +163,7 @@ class GenerationEngine:
                         net, config=old.config, adapter="auto",
                         draft_net=draft or old.draft_net,
                         trace_hook=self._on_trace,
-                        cost_path=old.cost_path).warm()
+                        cost_path=old.cost_path, mesh=old.mesh).warm()
                 finally:
                     self._resume_detectors()
             rt.active_ps = new_ps         # atomic: next admission cohort
@@ -192,6 +198,8 @@ class GenerationEngine:
             "prefix_cache": rt.active_ps.prefix_enabled,
             "kv_cache_dtype": rt.config.kv_cache_dtype,
             "kv_bytes_per_token": rt.active_ps.kv_bytes_per_token(),
+            "model_shards": rt.active_ps.model_shards,
+            "kv_pool_bytes_per_chip": rt.active_ps.kv_pool_chip_bytes,
             "speculative": {
                 "enabled": rt.active_ps.spec_k > 0,
                 "k": rt.active_ps.spec_k,

@@ -30,8 +30,12 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ...models.decode import LSTMDecodeSpec, TransformerDecodeSpec
+from ...parallel.tensor_parallel import (MODEL_AXIS, build_param_specs,
+                                         model_axis_size, per_replica_bytes,
+                                         shard_params)
 from ..programs import _arch_key, _tree_signature
 from .kvcache import (PagedStore, QuantSimStore, make_pools,
                       prefill_scatter)
@@ -140,7 +144,8 @@ class GenerationProgramSet:
     def __init__(self, net, *, config: GenerationConfig,
                  adapter: str = "auto", draft_net=None,
                  trace_hook: Optional[Callable[[], None]] = None,
-                 cost_path: Optional[str] = None):
+                 cost_path: Optional[str] = None,
+                 mesh: Optional[Mesh] = None):
         self.net = net
         self.config = config
         self._trace_hook = trace_hook
@@ -149,8 +154,33 @@ class GenerationProgramSet:
         self.adapter = self._resolve_adapter(net, adapter)
         self.spec = (TransformerDecodeSpec(net) if self.adapter == "paged"
                      else LSTMDecodeSpec(net))
+        # sharded decode (ISSUE 20): a ``(data, model)`` mesh with m > 1
+        # shards the Q/K/V/O projections and the paged KV pools by HEAD
+        # across the model axis — one decode step spans chips, the
+        # host-side block tables / allocator / prefix cache are untouched
+        # (they index blocks, and blocks keep their ids under sharding).
+        self.model_shards = model_axis_size(mesh)
+        self.mesh = mesh if self.model_shards > 1 else None
+        if self.model_shards > 1:
+            if self.adapter != "paged":
+                raise ValueError(
+                    "model-sharded decode requires the paged (transformer) "
+                    "adapter — the recurrent-state cache has no head axis "
+                    "to split")
+            if not self.spec.supports_head_sharding(self.model_shards):
+                raise ValueError(
+                    f"n_heads={self.spec.n_heads} does not divide by the "
+                    f"model axis ({self.model_shards}) — the paged pools "
+                    f"shard whole heads")
         self.params = jax.tree.map(jnp.asarray, net.params)
         self.state = jax.tree.map(jnp.asarray, net.state)
+        if self.mesh is not None:
+            self.params = shard_params(
+                self.mesh, self.params,
+                build_param_specs(net, self.model_shards))
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            self.state = jax.tree.map(
+                lambda a: jax.device_put(a, rep), self.state)
         self.dtype = self.spec.dtype
         self.vocab = self.spec.vocab
         # prefix-cache sharing only exists where there are blocks to share
@@ -186,6 +216,24 @@ class GenerationProgramSet:
                     f"{self.vocab} — proposals must share the token space")
             self.draft_params = jax.tree.map(jnp.asarray, draft_net.params)
             self.draft_state = jax.tree.map(jnp.asarray, draft_net.state)
+            if self.mesh is not None:
+                # the dense-transformer draft shards exactly like the
+                # target (same head recipe); a draft whose head count
+                # doesn't divide (or an LSTM draft) stays replicated —
+                # GSPMD keeps it correct, just not memory-split
+                self._draft_sharded = (
+                    self.draft_adapter == "dense"
+                    and self.draft_spec.supports_head_sharding(
+                        self.model_shards))
+                dspecs = (build_param_specs(draft_net, self.model_shards)
+                          if self._draft_sharded else
+                          jax.tree.map(lambda _: PartitionSpec(),
+                                       self.draft_params))
+                self.draft_params = shard_params(self.mesh,
+                                                 self.draft_params, dspecs)
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                self.draft_state = jax.tree.map(
+                    lambda a: jax.device_put(a, rep), self.draft_state)
             if self.draft_adapter == "state":
                 self._draft_init_states = self.draft_spec.init_states(
                     config.decode_slots + 1)
@@ -193,14 +241,18 @@ class GenerationProgramSet:
             _tree_signature(self.draft_params),
             _tree_signature(self.draft_state), _arch_key(draft_net),
             self.draft_adapter, self.spec_k)
+        mesh_sig = None if self.mesh is None else (
+            tuple(self.mesh.devices.shape), tuple(self.mesh.axis_names),
+            tuple(d.id for d in self.mesh.devices.flat))
         self.signature = (_tree_signature(self.params),
                           _tree_signature(self.state), _arch_key(net),
                           self.adapter, config.block_len, config.capacity,
                           config.decode_slots, config.prefill_batches,
                           config.prompt_rungs, config.num_blocks,
                           self.prefix_enabled, config.kv_cache_dtype,
-                          draft_sig)
+                          mesh_sig, draft_sig)
         self._compiled: Dict[Any, Any] = {}
+        self.kv_pool_chip_bytes: Optional[int] = None   # set by warm()
         if self.adapter == "state":
             self._init_states = self.spec.init_states(config.decode_slots + 1)
 
@@ -218,6 +270,18 @@ class GenerationProgramSet:
         return "state"
 
     # ---------------------------------------------------------------- cache
+    def _pool_sharding(self) -> Optional[NamedSharding]:
+        """Head-axis sharding for the paged pools: every pool-shaped array
+        in the decode subsystem carries its heads on axis 3 —
+        k/v pools [n_layers, nb, blk, H, Dh], int8 scales
+        [n_layers, nb, blk, H], dense draft caches
+        [n_layers, slots+1, cap, H, Dh] — so ONE spec serves them all
+        (PartitionSpec pads trailing axes with None)."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh,
+                             PartitionSpec(None, None, None, MODEL_AXIS))
+
     def make_cache(self):
         """Fresh cache pytree: (k_pool, v_pool) for the paged adapter, the
         zeroed recurrent-state carry (decode_slots + 1 rows, last row is
@@ -228,6 +292,9 @@ class GenerationProgramSet:
                                c.block_len, self.spec.n_heads,
                                self.spec.head_dim, self.dtype,
                                quantized=self.kv_quantized)
+            sh = self._pool_sharding()
+            if sh is not None:
+                cache = jax.tree.map(lambda a: jax.device_put(a, sh), cache)
         else:
             cache = jax.tree.map(jnp.zeros_like, self._init_states)
         try:     # memprof owner hint: the block pool dominates live HBM
@@ -263,10 +330,23 @@ class GenerationProgramSet:
             return None
         from .speculative import make_dense_draft_cache
         if self.draft_adapter == "dense":
-            return make_dense_draft_cache(self.draft_spec,
-                                          self.config.decode_slots,
-                                          self.config.capacity)
+            dcache = make_dense_draft_cache(self.draft_spec,
+                                            self.config.decode_slots,
+                                            self.config.capacity)
+            sh = self._pool_sharding()
+            if sh is not None and self._draft_sharded:
+                dcache = jax.tree.map(lambda a: jax.device_put(a, sh),
+                                      dcache)
+            return dcache
         return jax.tree.map(jnp.zeros_like, self._draft_init_states)
+
+    def kv_pool_bytes_per_chip(self, cache=None) -> int:
+        """Device bytes of the block pool resident on ONE chip — the
+        m×-reduction number the sharded-decode tier is bought for
+        (``generation.<m>.kv_pool_bytes_per_chip``). With no mesh this is
+        simply the full pool size."""
+        return per_replica_bytes(cache if cache is not None
+                                 else self.make_cache())
 
     # ------------------------------------------------------------- programs
     def _prefill_fn(self):
@@ -336,15 +416,22 @@ class GenerationProgramSet:
             return tok, cache, key
         return fn
 
+    def _sds(self, a):
+        # under a mesh the cache argument's layout is part of the AOT
+        # contract: lowering against the sharded spec is what compiles the
+        # one cross-chip decode step (and what keeps re-dispatch from
+        # recompiling — the runtime pools carry the same sharding)
+        if self.mesh is not None and hasattr(a, "sharding") \
+                and isinstance(a.sharding, NamedSharding):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
     def _cache_spec(self):
-        return jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            self.make_cache())
+        return jax.tree.map(self._sds, self.make_cache())
 
     def _draft_cache_spec(self):
-        return jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            self.make_draft_cache())
+        return jax.tree.map(self._sds, self.make_draft_cache())
 
     def _key_spec(self):
         k = self.fresh_key()
@@ -428,6 +515,7 @@ class GenerationProgramSet:
         # one touch per executable: first real traffic must not pay
         # dispatch-setup either
         cache, key = self.make_cache(), self.fresh_key()
+        self.kv_pool_chip_bytes = self.kv_pool_bytes_per_chip(cache)
         for P in c.prefill_batches:
             for L in c.prompt_rungs:
                 _, cache, key = self.run_prefill(
@@ -465,20 +553,24 @@ class GenerationProgramSet:
                 return
             idx = get_cost_index()
             base = self.cost_path
+            m = self.model_shards        # per-chip share of a tp program
             idx.register(f"{base}.decode_step",
                          program=self._compiled[("decode",)],
                          items_per_step=float(self.config.decode_slots),
+                         model_axis_size=m,
                          timing_metric=f"{base}.decode_step_ms")
             if ("verify",) in self._compiled:
                 idx.register(f"{base}.verify",
                              program=self._compiled[("verify",)],
                              items_per_step=float(self.config.decode_slots),
+                             model_axis_size=m,
                              timing_metric=f"{base}.verify_step_ms")
             for key, compiled in self._compiled.items():
                 if key[0] == "prefill":
                     _, P, L = key
                     idx.register(f"{base}.prefill.b{P}xp{L}",
-                                 program=compiled, items_per_step=float(P))
+                                 program=compiled, items_per_step=float(P),
+                                 model_axis_size=m)
         except Exception:       # pragma: no cover - defensive
             pass
 
@@ -656,9 +748,11 @@ class GenerationProgramSet:
                                    adapter=self.adapter,
                                    draft_net=draft_net or self.draft_net,
                                    trace_hook=self._trace_hook,
-                                   cost_path=self.cost_path)
+                                   cost_path=self.cost_path,
+                                   mesh=self.mesh)
         if new.signature != self.signature:
             raise ValueError("parameter/architecture changed; full warm-up "
                              "required")
         new._compiled = self._compiled
+        new.kv_pool_chip_bytes = self.kv_pool_chip_bytes
         return new

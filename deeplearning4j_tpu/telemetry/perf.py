@@ -167,6 +167,7 @@ class ProgramCost:
     peak_memory_bytes: Optional[float] = None
     steps_per_call: int = 1
     items_per_step: Optional[float] = None
+    model_axis_size: int = 1         # tensor-parallel ways (ISSUE 20)
     source: str = "unknown"          # compiled | lowered | analytic
     timing_metric: Optional[str] = None
     # fold state: last (count, sum) seen on the timing histogram
@@ -221,13 +222,18 @@ class ProgramCostIndex:
                  peak_memory_bytes: Optional[float] = None,
                  steps_per_call: int = 1,
                  items_per_step: Optional[float] = None,
+                 model_axis_size: int = 1,
                  timing_metric: Optional[str] = None,
                  source: Optional[str] = None) -> Optional[ProgramCost]:
         """Register (or refresh — last write wins per path) one program's
         cost. ``program`` may be a jax ``Compiled`` or ``Lowered``;
         explicit ``flops_per_step``/``bytes_per_step`` override it
         (mandatory for Pallas programs — XLA cannot see inside custom
-        calls). Returns None when no cost could be extracted."""
+        calls). ``model_axis_size`` divides the captured flops/bytes: a
+        tensor-parallel program's cost analysis counts the WHOLE model's
+        work, but each chip executes 1/m of it, so the per-chip MFU/
+        roofline gauges (peak numbers are per chip) must fold the
+        per-chip share. Returns None when no cost could be extracted."""
         if program is not None:
             ca = cost_analysis_of(program)
             if flops_per_step is None and ca.get("flops"):
@@ -245,12 +251,18 @@ class ProgramCostIndex:
             if reg.enabled:
                 reg.counter("perf.cost_capture_failures").inc()
             return None
+        m = max(1, int(model_axis_size))
+        if m > 1:
+            if flops_per_step is not None:
+                flops_per_step /= m
+            if bytes_per_step is not None:
+                bytes_per_step /= m
         entry = ProgramCost(
             path=path, flops_per_step=flops_per_step,
             bytes_per_step=bytes_per_step,
             peak_memory_bytes=peak_memory_bytes,
             steps_per_call=max(1, int(steps_per_call)),
-            items_per_step=items_per_step,
+            items_per_step=items_per_step, model_axis_size=m,
             source=source or "analytic", timing_metric=timing_metric)
         with self._lock:
             prev = self._entries.get(path)
@@ -264,6 +276,7 @@ class ProgramCostIndex:
 
     def maybe_capture(self, path: str, sig, jitted, args, kwargs=None, *,
                       steps_per_call: int = 1,
+                      model_axis_size: int = 1,
                       timing_metric: Optional[str] = None
                       ) -> Optional[ProgramCost]:
         """One-time cost capture for a ``jax.jit`` program: lower
@@ -284,6 +297,7 @@ class ProgramCostIndex:
             return None
         return self.register(path, program=lowered, source="lowered",
                              steps_per_call=steps_per_call,
+                             model_axis_size=model_axis_size,
                              timing_metric=timing_metric)
 
     # ------------------------------------------------------------- queries
@@ -352,6 +366,7 @@ class ProgramCostIndex:
                        "peak_memory_bytes": e.peak_memory_bytes,
                        "steps_per_call": e.steps_per_call,
                        "items_per_step": e.items_per_step,
+                       "model_axis_size": e.model_axis_size,
                        "source": e.source, "timing_metric": e.timing_metric,
                        "roofline": rf["bound"], "intensity": rf["intensity"],
                        "attainable_tflops": rf["attainable_tflops"],

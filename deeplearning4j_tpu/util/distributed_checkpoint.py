@@ -211,14 +211,16 @@ def restore_latest_sharded_checkpoint(directory: str, like: Any,
     ``(None, like, {})`` when nothing in the directory is restorable.
 
     ``resharder``: optional ``(directory, step, like, manifest) -> tree``
-    hook consulted when the candidate's manifest carries a ``sharding``
-    layout block (the ZeRO engine's shard-layout metadata). It may return
-    a re-sharded tree (state saved on a different mesh size re-sliced to
-    the current one — ``parallel.zero.make_zero_resharder``), or ``None``
-    to signal the layout already matches and the direct restore should
-    proceed. A resharder exception falls back to an older save like any
-    other restore failure, so a truncated or corrupt newest save never
-    blocks a re-shard recovery.
+    hook consulted for EVERY restorable candidate (a save from a
+    different mesh topology carries no special manifest block — only the
+    hook can tell by trying). It may return a re-sharded tree (state
+    saved on a different topology redistributed to the current one —
+    ``parallel.resharding.make_any_resharder``, or the ZeRO-specific
+    ``parallel.zero.make_zero_resharder``), or ``None`` to signal the
+    layout already matches and the direct restore should proceed. A
+    resharder exception falls back to an older save like any other
+    restore failure, so a truncated or corrupt newest save never blocks
+    a re-shard recovery.
 
     This is the recovery entry point: after a preemption the newest save
     is exactly the one most likely to be damaged (the writer died
@@ -232,7 +234,7 @@ def restore_latest_sharded_checkpoint(directory: str, like: Any,
         manifest = read_manifest(directory, step) or {}
         try:
             tree = None
-            if resharder is not None and manifest.get("sharding"):
+            if resharder is not None:
                 tree = resharder(directory, step, like, manifest)
             if tree is None:
                 tree = restore_sharded_checkpoint(directory, step, like)

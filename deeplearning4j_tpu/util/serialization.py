@@ -51,12 +51,20 @@ def write_model(net, path: str, save_updater: bool = True,
     ``extra_manifest``: JSON-serializable keys merged into the manifest
     (checkpointing stores its resume position — ``epochs_done``,
     ``step_within_epoch`` — there; readers treat a missing key as an
-    epoch-boundary save, so old zips stay loadable)."""
+    epoch-boundary save, so old zips stay loadable).
+
+    Model-sharded nets (a ``(data, model)`` ParallelWrapper left the
+    params tensor-parallel on device) are gathered to host FIRST — the
+    zip's flat buffers are layout-free, so a save made on any mesh loads
+    anywhere; ``host_gather`` raises loudly if a leaf is not fully
+    addressable from this process rather than writing a partial zip."""
+    from ..parallel.tensor_parallel import host_gather
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(CONFIG_ENTRY, net.conf.to_json())
-        params_flat = _flatten_tree(net.params).astype(np.float32)
+        params_flat = _flatten_tree(host_gather(net.params)).astype(
+            np.float32)
         z.writestr(PARAMS_ENTRY, params_flat.tobytes())
-        state_flat = _flatten_tree(net.state).astype(np.float32)
+        state_flat = _flatten_tree(host_gather(net.state)).astype(np.float32)
         z.writestr(STATE_ENTRY, state_flat.tobytes())
         manifest = {"format": "deeplearning4j_tpu-model", "version": 1,
                     "model_class": type(net).__name__,
@@ -75,7 +83,8 @@ def write_model(net, path: str, save_updater: bool = True,
                     "ParallelWrapper.gather_opt_state() (or "
                     "ZeroUpdateEngine.unshard_opt_state) before writing "
                     "a model zip, or pass save_updater=False")
-            upd_flat = _flatten_tree(net.opt_state).astype(np.float32)
+            upd_flat = _flatten_tree(host_gather(net.opt_state)).astype(
+                np.float32)
             z.writestr(UPDATER_ENTRY, upd_flat.tobytes())
             manifest["n_updater_state"] = int(upd_flat.size)
         if extra_manifest:

@@ -194,10 +194,16 @@ def test_transformer_lm_zoo_model_trains():
             "decoder is position-blind"
 
 
+@pytest.mark.slow
 def test_transformer_lm_token_input_trains():
     """token_input=True feeds [B,T] int ids through the
     EmbeddingSequenceLayer gather and learns the same shift-by-one task
-    (the TPU-first input path used by the transformer-LM bench row)."""
+    (the TPU-first input path used by the transformer-LM bench row).
+
+    Slow lane (tier-1 budget): the token-input path is trained in tier-1
+    by tests/test_tensor_parallel.py's mesh-parity fits and decoded all
+    through tests/test_generation.py; the learns-shift-by-one pin stays
+    via test_transformer_lm_zoo_model_trains (one-hot path)."""
     import numpy as np
 
     from deeplearning4j_tpu.models import transformer_lm
@@ -228,7 +234,10 @@ def test_transformer_lm_token_input_trains():
     assert net.num_params() == onehot.num_params() - 32
 
 
-@pytest.mark.parametrize("causal", [False, True])
+# non-causal variant in the slow lane (tier-1 budget): the causal case is
+# the production LM path and keeps the fused-vs-full contract pinned here
+@pytest.mark.parametrize("causal", [
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_fused_ring_matches_full_attention(causal):
     """The Pallas carry-emitting ring (flash_block_update per hop +
     lax.switch causality) must equal single-device full attention —

@@ -1,0 +1,133 @@
+"""Any-to-any resharding (parallel/resharding.py): a checkpoint saved
+under ANY (data, model) topology restores onto ANY other (ISSUE 20
+satellite — the full topology-portability matrix over (1,1) / (2,1) /
+(2,2) / (4,1)), with the truncated-newest walk-back discipline intact
+when the resharder is in play."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo_extra import transformer_lm
+from deeplearning4j_tpu.parallel import (ParallelWrapper, build_param_specs,
+                                         host_gather, make_any_resharder,
+                                         redistribute, shard_params)
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.tensor_parallel import build_opt_shardings
+from deeplearning4j_tpu.util.distributed_checkpoint import (
+    restore_latest_sharded_checkpoint, save_sharded_checkpoint)
+
+V = 29
+TOPOS = [(1, 1), (2, 1), (2, 2), (4, 1)]
+
+
+def _mesh(shape):
+    d, m = shape
+    return make_mesh(shape, ("data", "model"), jax.devices()[:d * m])
+
+
+@pytest.fixture(scope="module")
+def base():
+    """One short training for non-trivial params AND updater state; the
+    matrix below is purely about layout, so the same host values are
+    device_put onto each source topology before saving."""
+    net = transformer_lm(vocab_size=V, d_model=16, n_heads=4, n_blocks=1,
+                         max_length=16, seed=11, token_input=True).init()
+    rs = np.random.RandomState(0)
+    data = [DataSet(rs.randint(1, V, (8, 8)).astype(np.int32),
+                    np.eye(V)[rs.randint(0, V, (8, 8))].astype(np.float32))
+            for _ in range(2)]
+    ParallelWrapper(net, mesh_shape=(2, 1)).fit(data, epochs=1)
+    return net, {"params": host_gather(net.params),
+                 "opt": host_gather(net.opt_state)}
+
+
+def _placed(net, values, shape):
+    """values placed on ``shape``'s tp layout (params per the rule table,
+    updater slots inheriting their param's spec)."""
+    mesh = _mesh(shape)
+    specs = build_param_specs(net, shape[1])
+    params = shard_params(mesh, values["params"], specs)
+    opt_sh = build_opt_shardings(mesh, specs, values["params"],
+                                 values["opt"])
+    opt = jax.tree.map(lambda v, s: jax.device_put(v, s),
+                       values["opt"], opt_sh)
+    return {"params": params, "opt": opt}
+
+
+def _assert_matches(restored, like, values):
+    got = host_gather(restored)
+    for g, v in zip(jax.tree.leaves(got["params"]),
+                    jax.tree.leaves(values["params"])):
+        np.testing.assert_array_equal(g, v)         # params: bitwise
+    for g, v in zip(jax.tree.leaves(got["opt"]),
+                    jax.tree.leaves(values["opt"])):
+        np.testing.assert_allclose(g, v, atol=1e-6)  # opt: float tolerance
+    for r, l in zip(jax.tree.leaves(restored), jax.tree.leaves(like)):
+        assert r.sharding == l.sharding, (r.sharding, l.sharding)
+
+
+def test_topology_matrix_each_to_each(base, tmp_path):
+    net, values = base
+    for si, src in enumerate(TOPOS):
+        d = str(tmp_path / f"src{si}")
+        save_sharded_checkpoint(d, 5, _placed(net, values, src),
+                                extra={"src": list(src)})
+        for dst in TOPOS:
+            like = _placed(net, values, dst)
+            step, tree, extra = restore_latest_sharded_checkpoint(
+                d, like, resharder=make_any_resharder())
+            assert step == 5 and extra == {"src": list(src)}, (src, dst)
+            _assert_matches(tree, like, values)
+
+
+def test_truncated_newest_falls_back_past_resharder(base, tmp_path):
+    """The newest save is truncated mid-write: restore (with the any
+    resharder active) must walk back to the older valid save, not crash
+    and not feed the resharder a damaged archive."""
+    net, values = base
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, 1, _placed(net, values, (2, 1)))
+    save_sharded_checkpoint(d, 2, _placed(net, values, (2, 1)))
+    shard = os.path.join(d, "ckpt_step2_p000.npz")
+    with open(shard, "rb") as f:
+        head = f.read(64)
+    with open(shard, "wb") as f:
+        f.write(head)
+    like = _placed(net, values, (4, 1))
+    step, tree, _ = restore_latest_sharded_checkpoint(
+        d, like, resharder=make_any_resharder())
+    assert step == 1
+    _assert_matches(tree, like, values)
+
+
+def test_leaf_count_mismatch_walks_to_nothing(base, tmp_path):
+    """A save the resharder cannot interpret (leaf count disagrees with
+    ``like``) falls back like any other restore failure — here to
+    'nothing restorable', never a mis-sliced tree."""
+    net, values = base
+    d = str(tmp_path / "ckpt")
+    save_sharded_checkpoint(d, 3, _placed(net, values, (2, 2)))
+    like = {"params": _placed(net, values, (2, 1))["params"]}
+    step, tree, extra = restore_latest_sharded_checkpoint(
+        d, like, resharder=make_any_resharder())
+    assert step is None and tree is like and extra == {}
+
+
+def test_redistribute_is_pure_layout(base):
+    net, values = base
+    placed = _placed(net, values, (2, 2))["params"]
+    mesh41 = _mesh((4, 1))
+    specs41 = build_param_specs(net, 1)
+    back = redistribute(placed, mesh41, specs41)
+    for g, v in zip(jax.tree.leaves(host_gather(back)),
+                    jax.tree.leaves(values["params"])):
+        np.testing.assert_array_equal(g, v)
+    for leaf, spec in zip(
+            jax.tree.leaves(back),
+            jax.tree.leaves(specs41, is_leaf=lambda x: isinstance(x, P))):
+        assert leaf.sharding == NamedSharding(mesh41, spec)

@@ -42,7 +42,11 @@ def _data(dist, n=8, d=6):
 
 
 @pytest.mark.parametrize("dist", [
-    GaussianReconstructionDistribution(),
+    # plain-gaussian variant in the slow lane (tier-1 budget): the gaussian
+    # gradcheck stays pinned via gaussian-tanh here and the gaussian half
+    # of test_vae_pretrain_gradients_composite
+    pytest.param(GaussianReconstructionDistribution(),
+                 marks=pytest.mark.slow),
     GaussianReconstructionDistribution(activation="tanh"),
     BernoulliReconstructionDistribution(),
     ExponentialReconstructionDistribution(),
